@@ -1,0 +1,24 @@
+"""Test bring-up: 8 virtual CPU devices in one process.
+
+The TPU-native analogue of torch's gloo-on-CPU distributed testing
+(SURVEY.md §4): ``--xla_force_host_platform_device_count=8`` gives a real
+8-device mesh with real XLA collectives, so DP sharding, psum gradient
+equivalence, and cross-replica BN are all testable with no TPU attached.
+Must run before jax initializes, hence module scope here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# some environments ship a sitecustomize that force-registers a TPU plugin
+# and rewrites jax_platforms; pin it back to cpu before any backend spins up
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

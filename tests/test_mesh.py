@@ -1,0 +1,46 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudist import mesh as mesh_lib
+
+
+def test_default_mesh_is_pure_dp():
+    m = mesh_lib.create_mesh()
+    assert m.shape[mesh_lib.DATA_AXIS] == jax.device_count() == 8
+    assert mesh_lib.data_parallel_size(m) == 8
+
+
+def test_mesh_config_wildcard_and_validation():
+    cfg = mesh_lib.MeshConfig(data=-1, tensor=2)
+    m = mesh_lib.create_mesh(cfg)
+    assert m.shape[mesh_lib.DATA_AXIS] == 4
+    assert m.shape[mesh_lib.TENSOR_AXIS] == 2
+    with pytest.raises(ValueError):
+        mesh_lib.MeshConfig(data=3).axis_sizes(8)
+    with pytest.raises(ValueError):
+        mesh_lib.MeshConfig(data=-1, tensor=-1).axis_sizes(8)
+
+
+def test_shard_batch_places_rows_on_devices():
+    m = mesh_lib.create_mesh()
+    batch = {"image": np.arange(16 * 4, dtype=np.float32).reshape(16, 4),
+             "label": np.arange(16, dtype=np.int32)}
+    global_batch = mesh_lib.shard_batch(batch, m)
+    img = global_batch["image"]
+    assert img.shape == (16, 4)
+    assert len(img.sharding.device_set) == 8
+    # each device holds 2 rows
+    for shard in img.addressable_shards:
+        assert shard.data.shape == (2, 4)
+    np.testing.assert_array_equal(np.asarray(img), batch["image"])
+
+
+def test_global_batch_sizes():
+    m = mesh_lib.create_mesh()
+    per_replica, per_process = mesh_lib.global_batch_sizes(64, m)
+    assert per_replica == 8
+    assert per_process == 64
+    with pytest.raises(ValueError):
+        mesh_lib.global_batch_sizes(30, m)

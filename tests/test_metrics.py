@@ -1,0 +1,49 @@
+"""Log-format contract tests vs /root/reference/main.py:65-67,107-117
+(BASELINE.md: "Log format contract (to be reproduced exactly)")."""
+
+from tpudist.metrics import HEADER, MetricsLogger
+
+
+def test_header_and_filename(tmp_path):
+    logger = MetricsLogger("Job7", 128, 0, 8, log_dir=tmp_path)
+    assert logger.file_name.name == "Job7_128_0.log"
+    logger.finish()
+    lines = logger.file_name.read_text().splitlines()
+    assert lines[0] == HEADER.strip()
+    assert lines[0] == "datetime\tg_step\tg_img\tloss_value\texamples_per_sec"
+
+
+def test_rank0_rows_every_5_steps(tmp_path):
+    logger = MetricsLogger("J", 64, 0, 4, log_dir=tmp_path)
+    for step in range(1, 11):
+        logger.log_step(step, loss_value=2.5, step_duration=0.5)
+    logger.finish()
+    lines = logger.file_name.read_text().splitlines()
+    rows = [l for l in lines[1:] if not l.startswith("TrainTime")]
+    assert len(rows) == 2  # steps 5 and 10
+    f5 = rows[0].split("\t")
+    # g_step = global_step*world, g_img = g_step*batch (main.py:110)
+    assert f5[1] == str(5 * 4)
+    assert f5[2] == str(5 * 4 * 64)
+    assert f5[3] == "2.5"
+    assert abs(float(f5[4]) - 64 / 0.5) < 1e-6
+
+
+def test_nonzero_rank_writes_header_only(tmp_path):
+    logger = MetricsLogger("J", 64, 3, 4, log_dir=tmp_path)
+    for step in range(1, 11):
+        logger.log_step(step, 1.0, 0.1)
+    logger.finish()
+    lines = logger.file_name.read_text().splitlines()
+    assert lines[0].startswith("datetime")
+    assert len(lines) == 2 and lines[1].startswith("TrainTime\t")
+
+
+def test_traintime_footer_format(tmp_path):
+    logger = MetricsLogger("J", 1, 0, 1, log_dir=tmp_path)
+    t = logger.finish()
+    last = logger.file_name.read_text().splitlines()[-1]
+    tag, val = last.split("\t")
+    assert tag == "TrainTime"
+    assert float(val) >= 0 and t >= 0
+    assert "." in val  # %f formatting

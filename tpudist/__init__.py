@@ -1,0 +1,41 @@
+"""tpudist — a TPU-native distributed training framework.
+
+Brand-new JAX/XLA implementation of the capability surface of the reference
+PyTorch DDP example (Echozqn/PyTorch-Distributed-Training, see SURVEY.md):
+
+- launcher-driven ``env://`` multi-host bring-up
+  (reference: ``torch.distributed.launch`` + ``dist.init_process_group``,
+  /root/reference/main.py:34, README.md:12-35)
+- deterministic per-rank data sharding
+  (reference: ``DistributedSampler``, /root/reference/main.py:53,93)
+- data-parallel training with gradient all-reduce and cross-replica
+  batch-norm statistics (reference: DDP + SyncBatchNorm,
+  /root/reference/main.py:82-83,103)
+- per-step throughput/loss TSV logging
+  (reference: /root/reference/main.py:65-67,107-117)
+- windowed profiler tracing (reference: torch.profiler,
+  /root/reference/main.py:70-78,115)
+
+The design is TPU-first rather than a port: the reference's per-op NCCL
+machinery (bucketed async all-reduce, SyncBN all-gathers, pinned-memory
+staging) collapses into ONE pjit-compiled SPMD step over a named device
+mesh, with XLA inserting and overlapping the ICI/DCN collectives.
+"""
+
+from tpudist.mesh import MeshConfig, create_mesh, batch_sharding, replicated_sharding
+from tpudist.distributed import DistributedContext, init_from_env, reduce_loss
+from tpudist.data.sampler import DistributedSampler
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "DistributedContext",
+    "init_from_env",
+    "reduce_loss",
+    "DistributedSampler",
+    "__version__",
+]

@@ -1,0 +1,4 @@
+from tpudist.data.sampler import DistributedSampler
+from tpudist.data.loader import DataLoader
+
+__all__ = ["DistributedSampler", "DataLoader"]

@@ -1,0 +1,128 @@
+"""CIFAR-10/100 dataset — the reference's workload
+(``torchvision.datasets.CIFAR100(download=True)``, /root/reference/main.py:43-51).
+
+Self-contained loader: downloads the official tarball, parses the python
+pickle batches into numpy NHWC uint8 (TPU-native layout; torchvision is
+CHW), and caches under ``root``. Two deliberate deviations, both recorded in
+SURVEY.md:
+
+- **download race fixed** (§5): the reference lets every rank call
+  ``download=True`` concurrently on a shared filesystem; here only process 0
+  downloads and the rest wait on a barrier.
+- transform parity: the reference applies only ``ToTensor`` (float32 in
+  [0,1], no normalization/augmentation — §2a); :func:`to_tensor` reproduces
+  exactly that.
+
+For hermetic/egress-free runs, :func:`synthetic_cifar` generates a
+deterministic class-separable dataset with the same shapes/dtypes, used by
+the test suite and ``--synthetic`` mode.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tarfile
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+_SPECS = {
+    "cifar10": dict(
+        url="https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+        dirname="cifar-10-batches-py",
+        train_files=[f"data_batch_{i}" for i in range(1, 6)],
+        test_files=["test_batch"],
+        label_key=b"labels",
+        num_classes=10,
+    ),
+    "cifar100": dict(
+        url="https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz",
+        dirname="cifar-100-python",
+        train_files=["train"],
+        test_files=["test"],
+        label_key=b"fine_labels",
+        num_classes=100,
+    ),
+}
+
+
+def _download(root: Path, spec: dict) -> None:
+    """Rank-0-guarded download + extract (fixes the reference's race)."""
+    import jax
+
+    from tpudist.distributed import barrier
+
+    target = root / spec["dirname"]
+    if not target.exists() and jax.process_index() == 0:
+        root.mkdir(parents=True, exist_ok=True)
+        tar_path = root / Path(spec["url"]).name
+        if not tar_path.exists():
+            try:
+                urllib.request.urlretrieve(spec["url"], tar_path)
+            except OSError as e:
+                raise RuntimeError(
+                    f"could not download {spec['url']} ({e}). Either place "
+                    f"the extracted dataset at {root / spec['dirname']}, or "
+                    "run with --dataset synthetic for an egress-free stand-in."
+                ) from e
+        with tarfile.open(tar_path, "r:gz") as tf:
+            tf.extractall(root)
+    # every process joins the barrier unconditionally — a late-arriving
+    # process that already sees the extracted dataset must not strand rank 0
+    barrier("cifar-download")
+
+
+def load_cifar(
+    root: str | os.PathLike = "dataset",
+    dataset: str = "cifar100",
+    train: bool = True,
+    download: bool = True,
+) -> dict[str, np.ndarray]:
+    """Returns ``{"image": (N,32,32,3) uint8, "label": (N,) int32}``."""
+    spec = _SPECS[dataset]
+    root = Path(root)
+    if download:
+        _download(root, spec)
+    files = spec["train_files"] if train else spec["test_files"]
+    images, labels = [], []
+    for fname in files:
+        with open(root / spec["dirname"] / fname, "rb") as f:
+            entry = pickle.load(f, encoding="bytes")
+        data = entry[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        images.append(data)
+        labels.extend(entry[spec["label_key"]])
+    return {
+        "image": np.concatenate(images).astype(np.uint8),
+        "label": np.asarray(labels, np.int32),
+    }
+
+
+def synthetic_cifar(
+    n: int = 2048,
+    num_classes: int = 100,
+    image_size: int = 32,
+    seed: int = 0,
+) -> dict[str, np.ndarray]:
+    """Deterministic class-separable stand-in with CIFAR shapes/dtypes.
+
+    Each class has a fixed random template; samples are template + noise, so
+    a real model can drive the loss down (needed by the loss-decrease smoke
+    test, SURVEY.md §4).
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+    templates = rng.integers(0, 256, (num_classes, image_size, image_size, 3))
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    noise = rng.normal(0, 24, (n, image_size, image_size, 3))
+    images = np.clip(templates[labels] * 0.7 + 64 + noise, 0, 255).astype(np.uint8)
+    return {"image": images, "label": labels}
+
+
+def to_tensor(batch: dict) -> dict:
+    """The reference's ``ToTensor`` transform (/root/reference/main.py:46):
+    uint8 [0,255] → float32 [0,1]; layout stays NHWC (TPU-native)."""
+    out = dict(batch)
+    out["image"] = np.asarray(batch["image"], np.float32) / 255.0
+    return out

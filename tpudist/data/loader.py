@@ -1,0 +1,135 @@
+"""Host-side batch assembly and device staging.
+
+TPU-native replacement for ``DataLoader(pin_memory=True)`` + in-loop
+``.cuda()`` copies (/root/reference/main.py:54-63,98-99). The reference's
+synchronous per-step H2D copy sits on the critical path (SURVEY.md §7 "hard
+parts" #1); here batches are assembled from an in-memory numpy dataset
+(vectorized gather — optionally via the C++ batcher in tpudist/csrc) and
+staged onto the mesh with ``shard_batch``, with an N-deep prefetch queue so
+the copy for step k+1 overlaps the compute of step k.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Iterator, Mapping
+
+import numpy as np
+
+from tpudist.data.sampler import DistributedSampler
+
+
+class DataLoader:
+    """Iterates minibatches of an array-backed dataset for one epoch.
+
+    ``dataset`` is a mapping of name → numpy array, all with equal leading
+    dimension (e.g. ``{"image": (N,32,32,3) uint8, "label": (N,) int32}``).
+    A ``DistributedSampler`` supplies this rank's index shard; batches are
+    gathered host-side and handed to ``transform`` (e.g. uint8→float32
+    normalization, augmentation) before staging.
+
+    Matches the reference loader's contract: ``shuffle=False`` at the loader
+    (the sampler owns shuffling, /root/reference/main.py:56-58) and
+    ``drop_last=False`` → final short batch is dropped only if
+    ``drop_remainder`` (pjit needs static shapes, so the default drops the
+    ragged tail — with the sampler's padding this loses < one batch/epoch).
+    """
+
+    def __init__(
+        self,
+        dataset: Mapping[str, np.ndarray],
+        batch_size: int,
+        sampler: DistributedSampler | None = None,
+        transform: Callable[[dict], dict] | None = None,
+        drop_remainder: bool = True,
+    ):
+        sizes = {k: len(v) for k, v in dataset.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"ragged dataset arrays: {sizes}")
+        self.dataset = dict(dataset)
+        self.size = next(iter(sizes.values()))
+        self.batch_size = batch_size
+        self.sampler = sampler or DistributedSampler(
+            self.size, num_replicas=1, rank=0, shuffle=False
+        )
+        self.transform = transform
+        self.drop_remainder = drop_remainder
+
+    def __len__(self) -> int:
+        n = self.sampler.num_samples
+        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[dict]:
+        indices = self.sampler.epoch_indices()
+        limit = len(self) * self.batch_size if self.drop_remainder else len(indices)
+        for start in range(0, limit, self.batch_size):
+            idx = indices[start : start + self.batch_size]
+            batch = {k: v[idx] for k, v in self.dataset.items()}
+            if self.transform is not None:
+                batch = self.transform(batch)
+            yield batch
+
+
+def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
+    """Stage host batches onto the device mesh ``depth`` steps ahead.
+
+    The replacement for pinned-memory + synchronous ``.cuda()``: device_put
+    is async in JAX, so keeping ``depth`` batches in flight overlaps host
+    gather + H2D DMA with on-device compute. A background thread runs the
+    host-side gather/transform so it too leaves the critical path.
+
+    ``stage_fn`` overrides the default flat-batch sharding (used e.g. by the
+    grad-accumulation path, which folds a microbatch dim in first).
+    """
+    from tpudist.mesh import shard_batch
+
+    queue: collections.deque = collections.deque()
+    host_q: collections.deque = collections.deque()
+    lock = threading.Condition()
+    DONE = object()
+
+    def _producer():
+        try:
+            for item in iterator:
+                with lock:
+                    while len(host_q) >= depth + 1:
+                        lock.wait()
+                    host_q.append(item)
+                    lock.notify_all()
+        except BaseException as e:  # surface loader errors to the consumer
+            with lock:
+                host_q.append(e)
+                lock.notify_all()
+        finally:
+            with lock:
+                host_q.append(DONE)
+                lock.notify_all()
+
+    thread = threading.Thread(target=_producer, daemon=True)
+    thread.start()
+
+    def _next_host():
+        with lock:
+            while not host_q:
+                lock.wait()
+            item = host_q.popleft()
+            lock.notify_all()
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    if stage_fn is None:
+        stage_fn = lambda b: shard_batch(b, mesh)
+
+    finished = False
+    while True:
+        while not finished and len(queue) < depth:
+            item = _next_host()
+            if item is DONE:
+                finished = True
+            else:
+                queue.append(stage_fn(item))
+        if not queue:
+            return
+        yield queue.popleft()

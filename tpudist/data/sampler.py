@@ -1,0 +1,98 @@
+"""Deterministic per-rank data sharding.
+
+Re-implements the *semantics* of ``torch.utils.data.DistributedSampler`` as
+driven by the reference (/root/reference/main.py:53,93 — all-default
+construction, so ``shuffle=True``, ``seed=0``, ``drop_last=False``):
+
+1. permutation of ``len(dataset)`` indices keyed by ``seed + epoch``
+   (``set_epoch`` re-keys the shuffle each epoch, /root/reference/main.py:89-93);
+2. pad to a multiple of ``num_replicas`` by wrapping indices from the head
+   (``drop_last=False`` default) — or truncate when ``drop_last=True``;
+3. strided subsample ``indices[rank::num_replicas]``.
+
+The permutation itself comes from numpy's PCG64 rather than torch's MT19937 —
+bit-identical torch order is not a capability, determinism and
+disjoint-coverage are (SURVEY.md §2.6).
+
+On TPU the "rank" that consumes a shard is a *process* (host), and the
+process's shard is further split across its local devices by
+``mesh.shard_batch``; using ``rank=process_index, num_replicas=process_count``
+reproduces the reference's per-worker disjointness at host granularity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Index sampler yielding this rank's shard of the dataset each epoch."""
+
+    def __init__(
+        self,
+        dataset_size: int | Sequence,
+        num_replicas: int | None = None,
+        rank: int | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not isinstance(dataset_size, int):
+            dataset_size = len(dataset_size)
+        if num_replicas is None or rank is None:
+            import jax
+
+            num_replicas = jax.process_count() if num_replicas is None else num_replicas
+            rank = jax.process_index() if rank is None else rank
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last and dataset_size % num_replicas != 0:
+            self.num_samples = dataset_size // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_size / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Re-key the shuffle for a new epoch — without this every epoch
+        replays the same order (the exact pitfall the reference's comment
+        warns about, /root/reference/main.py:89-92)."""
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        if self.drop_last:
+            indices = indices[: self.total_size]
+        else:
+            pad = self.total_size - len(indices)
+            if pad > 0:
+                # wrap from the head, repeating the whole sequence if the pad
+                # exceeds the dataset (torch semantics)
+                reps = math.ceil(pad / len(indices))
+                indices = np.concatenate([indices, np.tile(indices, reps)[:pad]])
+        assert len(indices) == self.total_size
+        return indices[self.rank :: self.num_replicas]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self._indices().tolist())
+
+    def epoch_indices(self) -> np.ndarray:
+        """This rank's full index shard for the current epoch (vectorized
+        form of ``__iter__`` for array-at-once loaders)."""
+        return self._indices()
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,110 @@
+"""Process launcher — the ``torch.distributed.launch`` equivalent.
+
+The reference is launched as ``python -m torch.distributed.launch
+--nproc_per_node=N [--nnode --node_rank --master_addr --master_port]
+main.py args...`` (/root/reference/README.md:12-35). This module preserves
+that CLI shape:
+
+    python -m tpudist.launch --nproc_per_node=N \
+        [--nnode=M --node_rank=r --master_addr=A --master_port=P] \
+        main.py --batch_size 128 --JobID Job0
+
+and reproduces the launcher contract (SURVEY.md §2.2): it spawns
+``nproc_per_node`` local processes, exports ``MASTER_ADDR``,
+``MASTER_PORT``, ``RANK``, ``WORLD_SIZE``, ``LOCAL_RANK`` to each, and
+injects ``--local_rank=i`` into argv — which ``tpudist.distributed
+.init_from_env`` consumes the way ``dist.init_process_group('env://')``
+does.
+
+On TPU pods the natural topology is ONE process per host driving all local
+chips (so ``--nproc_per_node`` defaults to 1 and ``--nnode/--node_rank``
+describe hosts); ``--nproc_per_node>1`` exists for local CPU emulation of a
+multi-process world (each process gets a disjoint slice of fake CPU devices
+via ``--emulate-devices``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpudist.launch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    # flag names match torch.distributed.launch as used in README.md:14,28,34
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--nnode", "--nnodes", type=int, default=1, dest="nnode")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master_addr", type=str, default="127.0.0.1")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument(
+        "--emulate-devices", type=int, default=0,
+        help="give each spawned process this many fake CPU devices "
+        "(sets JAX_PLATFORMS=cpu + xla_force_host_platform_device_count); "
+        "for TPU-less testing of the multi-process path",
+    )
+    p.add_argument("--no_python", action="store_true",
+                   help="run the script as an executable instead of `python script`")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    world_size = args.nnode * args.nproc_per_node
+    procs: list[subprocess.Popen] = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(
+            MASTER_ADDR=args.master_addr,
+            MASTER_PORT=str(args.master_port),
+            RANK=str(rank),
+            WORLD_SIZE=str(world_size),
+            LOCAL_RANK=str(local_rank),
+        )
+        if args.emulate_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["TPUDIST_FORCE_CPU"] = "1"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.emulate_devices}"
+            ).strip()
+        cmd = [] if args.no_python else [sys.executable, "-u"]
+        cmd = cmd + [args.script, f"--local_rank={local_rank}"] + args.script_args
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def _kill(signum, frame):
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+    except KeyboardInterrupt:
+        _kill(None, None)
+        for p in procs:
+            p.wait()
+        rc = 130
+    if rc:
+        # fail-fast semantics: if any rank failed, reap the rest so the
+        # world doesn't hang half-formed (SURVEY.md §5 failure detection:
+        # static world, fail-fast on loss of a member)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""Device mesh construction and sharding helpers.
+
+TPU-native replacement for the reference's NCCL communicator / process group
+(/root/reference/main.py:34): instead of a flat rank set with explicit
+collectives, tpudist arranges all devices into a named
+:class:`jax.sharding.Mesh` and expresses parallelism as shardings over named
+axes. The reference only has data parallelism (SURVEY.md §2.12), so the
+default mesh is 1-D over axis ``"data"`` — but the mesh is N-D-ready so that
+tensor/pipeline/sequence axes can be added without reshaping the framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, in mesh order. Data-parallel is the outermost axis so
+# that gradient all-reduce rides the largest ring.
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tensor"
+SEQUENCE_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+_AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``-1`` on an axis means "all remaining devices".
+
+    Default is pure data parallelism over every visible device — the exact
+    capability of the reference's DDP world (/root/reference/main.py:83).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def axis_sizes(self, n_devices: int) -> dict[str, int]:
+        sizes = {
+            DATA_AXIS: self.data,
+            FSDP_AXIS: self.fsdp,
+            EXPERT_AXIS: self.expert,
+            SEQUENCE_AXIS: self.seq,
+            TENSOR_AXIS: self.tensor,
+        }
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices but {n_devices} are visible"
+            )
+        return sizes
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the named device mesh.
+
+    Axes of size 1 are kept (named, size-1) so sharding specs can always
+    mention every canonical axis; XLA elides trivial collectives.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    sizes = config.axis_sizes(len(devices))
+    shape = tuple(sizes[a] for a in _AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, _AXIS_ORDER)
+
+
+def batch_sharding(mesh: Mesh, *, extra_dims: int = 3) -> NamedSharding:
+    """Sharding for a training batch: leading (batch) dim split over ``data``
+    (and ``fsdp`` when present), remaining dims replicated.
+
+    This is the TPU-native form of DistributedSampler's per-rank shard
+    (/root/reference/main.py:53): the global batch is one logical array whose
+    rows live on the device that will compute them.
+    """
+    spec = P((DATA_AXIS, FSDP_AXIS), *([None] * extra_dims))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding — used for model parameters in plain DP,
+    mirroring DDP's replicate-everywhere model (/root/reference/main.py:83).
+    """
+    return NamedSharding(mesh, P())
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """Number of data-parallel replicas (the reference's ``world_size``,
+    /root/reference/main.py:37, where one GPU = one replica)."""
+    return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+
+
+def global_batch_sizes(
+    global_batch: int, mesh: Mesh
+) -> tuple[int, int]:
+    """(per-replica batch, per-process batch) for a given global batch."""
+    n = data_parallel_size(mesh)
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} replicas")
+    per_replica = global_batch // n
+    per_process = global_batch // jax.process_count()
+    return per_replica, per_process
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host-local batch (numpy pytree) onto the mesh, sharded over the
+    batch dimension.
+
+    Single-process: a plain sharded ``device_put``. Multi-process: each host
+    contributes its local shard and the result is the global logical array —
+    the TPU-native equivalent of every DDP rank holding its own minibatch.
+    """
+    def _put(x):
+        x = np.asarray(x)
+        sharding = batch_sharding(mesh, extra_dims=x.ndim - 1)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(_put, batch)

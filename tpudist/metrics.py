@@ -1,0 +1,86 @@
+"""Throughput/loss TSV logging — the reference's de-facto metrics API.
+
+Reproduces the exact file contract of /root/reference/main.py:65-67,107-117:
+
+- every rank opens ``{jobId}_{batch_size}_{global_rank}.log`` and writes the
+  header ``datetime\tg_step\tg_img\tloss_value\texamples_per_sec``;
+- only rank 0 appends rows, every ``log_every`` (5) global steps:
+  ``{datetime.now()}\t{global_step*world_size}\t{global_step*world_size*batch_size}\t{loss}\t{examples_per_sec}``
+  where ``examples_per_sec = batch_size / step_duration`` is *per-rank*
+  throughput (a documented quirk of the reference — preserved for
+  apples-to-apples baseline comparison, SURVEY.md §7 hard-part #4);
+- rank 0 prints ``Epoch: {e} step: {idx} loss: {loss}`` every
+  ``print_every`` (10) batches (/root/reference/main.py:113-114);
+- a final ``TrainTime\t%f`` row with total wall seconds
+  (/root/reference/main.py:117).
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime
+from pathlib import Path
+
+HEADER = "datetime\tg_step\tg_img\tloss_value\texamples_per_sec\n"
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        job_id: str,
+        batch_size: int,
+        global_rank: int,
+        world_size: int,
+        *,
+        log_every: int = 5,
+        print_every: int = 10,
+        log_dir: str | Path = ".",
+    ):
+        self.job_id = job_id
+        self.batch_size = batch_size
+        self.global_rank = global_rank
+        self.world_size = world_size
+        self.log_every = log_every
+        self.print_every = print_every
+        Path(log_dir).mkdir(parents=True, exist_ok=True)
+        self.file_name = Path(log_dir) / f"{job_id}_{batch_size}_{global_rank}.log"
+        # every rank opens + writes the header; only rank 0 writes rows —
+        # exact reference behavior (main.py:65-67 vs :107)
+        self._file = open(self.file_name, "w")
+        self._file.write(HEADER)
+        self._file.flush()
+        self._train_begin = time.time()
+
+    def start_timer(self) -> None:
+        """Reset the TrainTime clock (reference starts it just before the
+        epoch loop, main.py:87)."""
+        self._train_begin = time.time()
+
+    def log_step(self, global_step: int, loss_value: float, step_duration: float) -> None:
+        """Call once per step on every rank; writes on rank 0 at the cadence."""
+        if self.global_rank == 0 and global_step % self.log_every == 0:
+            examples_per_sec = self.batch_size / step_duration
+            row = (
+                f"{datetime.now()}\t{global_step * self.world_size}\t"
+                f"{global_step * self.world_size * self.batch_size}\t"
+                f"{loss_value}\t{examples_per_sec}\n"
+            )
+            self._file.write(row)
+            self._file.flush()
+
+    def print_progress(self, epoch: int, idx: int, loss_value: float) -> None:
+        if self.global_rank == 0 and idx % self.print_every == 0:
+            print("Epoch: {} step: {} loss: {}".format(epoch, idx, loss_value))
+
+    def finish(self) -> float:
+        train_time = time.time() - self._train_begin
+        self._file.write("TrainTime\t%f\n" % train_time)
+        self._file.close()
+        return train_time
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if not self._file.closed:
+            self.finish()

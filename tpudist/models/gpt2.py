@@ -1,0 +1,72 @@
+"""GPT-2 decoder in Flax — BASELINE.json config 5 (GPT-2 124M, DP + grad
+accumulation, tokens/sec).
+
+No reference counterpart (SURVEY.md §2.12); built for the LM leg of the
+baseline ladder. TPU-first: causal attention through tpudist.ops (XLA or
+Pallas flash path), bf16 compute with fp32 params, weight-tied LM head as a
+single MXU matmul against the embedding table.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.ops.attention import multi_head_attention
+
+
+class Block(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.num_heads
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(y)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
+        y = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(attn)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        y = nn.Dense(4 * d, dtype=self.dtype, name="mlp_fc")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, dtype=self.dtype, name="mlp_proj")(y)
+        return x + y
+
+
+class GPT2(nn.Module):
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        b, s = tokens.shape
+        wte = self.param(
+            "wte", nn.initializers.normal(0.02), (self.vocab_size, self.hidden_dim), jnp.float32
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.01), (self.max_seq_len, self.hidden_dim), jnp.float32
+        )
+        x = wte[tokens].astype(self.dtype) + wpe[:s].astype(self.dtype)
+        for i in range(self.depth):
+            x = Block(self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl, name=f"h_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # weight-tied LM head
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, wte.astype(self.dtype), preferred_element_type=jnp.float32
+        )
+        return logits
+
+
+def gpt2_124m(**kw) -> GPT2:
+    return GPT2(**kw)

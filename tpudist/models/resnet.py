@@ -1,0 +1,131 @@
+"""ResNet in Flax (NHWC, TPU-native).
+
+Equivalent of ``torchvision.models.resnet50`` as used by the reference
+(/root/reference/main.py:8,40): 25.6M-param bottleneck ResNet-50 with
+batch-norm everywhere and a 1000-way head (the reference does NOT adapt the
+head to CIFAR-100 — ``num_classes`` defaults to 1000 for parity, SURVEY.md
+§2a). ResNet-18 covers BASELINE config 1.
+
+TPU-first choices:
+- NHWC layout (XLA's native conv layout on TPU; torchvision is NCHW).
+- Cross-replica batch-norm — the reference wraps the net in
+  ``SyncBatchNorm.convert_sync_batchnorm`` (/root/reference/main.py:82) so BN
+  statistics span the *global* batch. Under pjit/GSPMD the batch is one
+  logical array sharded over the ``data`` axis, so plain ``nn.BatchNorm``
+  already computes global-batch statistics (XLA inserts the cross-replica
+  reduction); ``axis_name`` is accepted for explicit shard_map/pmap use.
+- bf16-friendly: ``dtype`` controls activation/compute precision; params and
+  BN statistics stay float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    """Basic 3x3+3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        # final BN of each block: scale init zeros (standard modern recipe is
+        # optional; torchvision inits gamma=1, keep 1 for parity)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), (self.strides, self.strides), name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck block (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), (self.strides, self.strides), name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+    axis_name: str | None = None
+    small_inputs: bool = False  # CIFAR stem: 3x3/s1 conv, no maxpool
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.axis_name,
+        )
+        x = jnp.asarray(x, self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    self.num_filters * 2**i,
+                    conv=conv,
+                    norm=norm,
+                    strides=strides,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock, **kw)

@@ -1,0 +1,87 @@
+"""ViT-B/16 in Flax — BASELINE.json config 4 (ViT-B/16, DP + bfloat16).
+
+No reference counterpart exists (the reference is ResNet-only,
+/root/reference/main.py:40); this covers the "transformer grads over ICI"
+target. TPU-first: bf16 activations with fp32 params, patchify as a single
+strided conv (one big MXU matmul), attention via tpudist.ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.ops.attention import multi_head_attention
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(d, dtype=self.dtype)(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.num_heads
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(y)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = multi_head_attention(q, k, v, impl=self.attn_impl)
+        y = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(attn)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        return x + MlpBlock(self.mlp_dim, dtype=self.dtype)(y)
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        p = self.patch_size
+        x = nn.Conv(
+            self.hidden_dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, name="embedding",
+        )(x)
+        b, gh, gw, d = x.shape
+        x = x.reshape(b, gh * gw, d)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, d), jnp.float32)
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02), (1, x.shape[1], d), jnp.float32
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                attn_impl=self.attn_impl, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+def vit_b16(**kw) -> ViT:
+    return ViT(**kw)

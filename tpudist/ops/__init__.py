@@ -1,0 +1,3 @@
+from tpudist.ops.attention import multi_head_attention, dot_product_attention
+
+__all__ = ["multi_head_attention", "dot_product_attention"]

@@ -1,0 +1,49 @@
+"""Attention ops for the transformer models (ViT, GPT-2).
+
+The reference contains no attention (its workload is a CNN, SURVEY.md §5
+"long-context: ABSENT") — these ops serve the BASELINE ladder's transformer
+configs (ViT-B/16, GPT-2 124M). Two paths:
+
+- ``dot_product_attention``: plain XLA einsum attention. XLA fuses
+  softmax+matmul well on TPU; this is the default and the correctness oracle.
+- a Pallas flash-attention kernel (``tpudist.ops.flash_attention``) for long
+  sequences, selected with ``impl="flash"`` — blockwise online-softmax so the
+  S×S score matrix never materializes in HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False, mask=None):
+    """q,k,v: [B, S, H, D] (batch, seq, heads, head_dim) → [B, S, H, D]."""
+    dtype = q.dtype
+    depth = q.shape[-1]
+    scale = 1.0 / np.sqrt(depth).astype(np.float32)
+    # compute scores in float32 for stability, cast back at the end
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def multi_head_attention(q, k, v, *, causal: bool = False, mask=None, impl: str = "xla"):
+    if impl == "flash" and mask is None:
+        try:
+            from tpudist.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal)
+        except (ImportError, NotImplementedError) as e:
+            import warnings
+
+            warnings.warn(f"flash attention unavailable ({e}); using XLA attention")
+    return dot_product_attention(q, k, v, causal=causal, mask=mask)

@@ -1,0 +1,12 @@
+"""Parallelism strategies.
+
+The reference's only strategy is data parallelism (DDP, SURVEY.md §2.12) —
+expressed here as shardings over the named mesh (tpudist.mesh +
+tpudist.train). This package holds the strategy-level helpers: DP sharding
+rules and grad accumulation; the mesh's extra named axes (fsdp/tensor/seq/
+expert) keep the door open for further strategies beyond parity.
+"""
+
+from tpudist.parallel.dp import dp_shardings
+
+__all__ = ["dp_shardings"]

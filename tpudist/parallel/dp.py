@@ -1,0 +1,23 @@
+"""Data-parallel sharding rules — the DDP equivalence (SURVEY.md §2.5).
+
+DDP = params replicated on every worker + per-step gradient all-reduce.
+On the TPU mesh that is exactly: params/opt-state replicated, batch sharded
+over ``data``; the all-reduce is implicit in ``jax.grad`` of a global-batch
+mean under GSPMD.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.mesh import DATA_AXIS, FSDP_AXIS, replicated_sharding
+
+
+def dp_shardings(mesh: Mesh, batch_ndims: dict[str, int]):
+    """(state_sharding, batch_shardings) for a plain DP step."""
+    state = replicated_sharding(mesh)
+    batch = {
+        k: NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS), *([None] * (nd - 1))))
+        for k, nd in batch_ndims.items()
+    }
+    return state, batch

@@ -1,0 +1,79 @@
+"""Windowed profiler tracing.
+
+Replaces ``torch.profiler.profile(schedule=schedule(wait=2, warmup=2,
+active=6, repeat=1), tensorboard_trace_handler('./log_{jobId}'))``
+(/root/reference/main.py:70-78,115) with :mod:`jax.profiler`: after
+``wait + warmup`` steps are skipped, a single ``active``-step window is
+captured via ``start_trace``/``stop_trace`` into ``./log_{jobId}`` — the
+same per-job directory convention — producing TensorBoard/XProf-viewable
+traces with the TPU device timeline and HLO ops (Kineto's CUPTI role is
+played by the XLA runtime's own instrumentation; SURVEY.md §2.10).
+
+Usage mirrors the reference: wrap training in the context manager and call
+``p.step()`` once per iteration.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class WindowedProfiler:
+    def __init__(
+        self,
+        job_id: str,
+        *,
+        wait: int = 2,
+        warmup: int = 2,
+        active: int = 6,
+        repeat: int = 1,
+        log_dir: str | Path | None = None,
+        enabled: bool = True,
+    ):
+        # torch semantics: skip `wait`, then `warmup` (instrument, discard),
+        # then record `active` steps; `repeat` cycles. jax.profiler has no
+        # warmup/active distinction, so the capture window is `active` steps
+        # beginning after wait+warmup.
+        self.skip = wait + warmup
+        self.active = active
+        self.repeat = repeat
+        self.log_dir = str(log_dir if log_dir is not None else f"./log_{job_id}")
+        self.enabled = enabled
+        self._step = 0
+        self._cycle = 0
+        self._tracing = False
+
+    def __enter__(self):
+        return self
+
+    def step(self) -> None:
+        """Advance the schedule; call once per training iteration
+        (the ``p.step()`` of /root/reference/main.py:115)."""
+        if not self.enabled or self._cycle >= self.repeat:
+            return
+        self._step += 1
+        if not self._tracing and self._step == self.skip:
+            Path(self.log_dir).mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+            self._window_end = self._step + self.active
+        elif self._tracing and self._step >= self._window_end:
+            self._stop()
+
+    def _stop(self) -> None:
+        # block_until_ready is implicit: stop_trace flushes what the runtime
+        # has; callers log loss each step so device work is already synced.
+        jax.profiler.stop_trace()
+        self._tracing = False
+        self._cycle += 1
+        self._step = 0
+        logger.info("profiler trace written to %s", self.log_dir)
+
+    def __exit__(self, *exc):
+        if self._tracing:
+            self._stop()

@@ -1,0 +1,304 @@
+"""The compiled training step and epoch driver.
+
+This is where the reference's layers L2–L5 collapse (SURVEY.md §3.4): the
+per-step sequence ``.cuda() → forward (SyncBN all-gathers) → loss →
+zero_grad → backward (DDP bucketed async all-reduce) → opt.step() →
+reduce_loss`` (/root/reference/main.py:98-105) becomes ONE jit-compiled SPMD
+program over the device mesh:
+
+- params are replicated, the batch is sharded over the ``data`` axis;
+- the loss is the mean over the *global* logical batch, so ``jax.grad``
+  produces already-all-reduced gradients — XLA inserts the ICI/DCN psum and
+  overlaps it with backward compute, which *is* the TPU-native equivalent of
+  DDP's C++ Reducer bucketing (SURVEY.md §2.5);
+- batch-norm statistics are computed over the global batch inside the same
+  program (the SyncBatchNorm equivalent, §2.8);
+- the Adam update (optax) runs in-graph (§2.9);
+- the only host↔device traffic is the batch in and the scalar loss out.
+
+Init-sync: DDP broadcasts rank-0 params at wrap time (main.py:83);
+:func:`create_train_state` instead initializes from an explicit PRNG seed
+inside a compiled program with replicated output sharding, so every process
+holds bit-identical params by construction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from flax.core import FrozenDict
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist import mesh as mesh_lib
+from tpudist.metrics import MetricsLogger
+from tpudist.profiling import WindowedProfiler
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any  # empty FrozenDict for models without BN
+    opt_state: Any
+
+
+def cross_entropy_loss(logits, labels):
+    """Softmax CE on logits vs int labels — the reference's
+    ``CrossEntropyLoss`` (/root/reference/main.py:79,101)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def lm_loss(logits, tokens):
+    """Next-token CE for the GPT-2 config: predict tokens[1:] from tokens[:-1]."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]
+    ).mean()
+
+
+def create_train_state(
+    model,
+    rng: jax.Array | int,
+    sample_input,
+    tx: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+) -> TrainState:
+    """Initialize params/opt state, replicated over the mesh.
+
+    Same seed on every process ⇒ bit-identical replicated params — the
+    TPU-native init-sync replacing DDP's rank-0 broadcast (SURVEY.md §2.5).
+    """
+    if isinstance(rng, int):
+        rng = jax.random.key(rng)
+
+    def _init():
+        variables = model.init(rng, sample_input, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", FrozenDict())
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+        )
+
+    if mesh is None:
+        return jax.jit(_init)()
+    repl = mesh_lib.replicated_sharding(mesh)
+    return jax.jit(_init, out_shardings=repl)()
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    input_key: str = "image",
+    label_key: str = "label",
+    grad_accum: int = 1,
+    remat: bool = False,
+):
+    """Build the jit-compiled (state, batch) → (state, metrics) step.
+
+    ``grad_accum > 1`` scans over ``grad_accum`` microbatches
+    (batch leading dims ``[grad_accum, micro_batch, ...]``, microbatch dim
+    sharded over ``data``) accumulating gradients in fp32 — the
+    BASELINE.json config-5 extension; XLA still emits a single fused program
+    with one logical all-reduce per step.
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint`` to trade FLOPs
+    for HBM (useful for long-sequence GPT-2).
+    """
+    batch_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+
+    def forward(params, batch_stats, batch):
+        variables = {"params": params, "batch_stats": batch_stats}
+        has_stats = len(batch_stats) > 0
+        inputs = batch[input_key]
+        if has_stats:
+            logits, updates = model.apply(
+                variables, inputs, train=True, mutable=["batch_stats"]
+            )
+            new_stats = updates["batch_stats"]
+        else:
+            logits = model.apply(variables, inputs, train=True)
+            new_stats = batch_stats
+        loss = loss_fn(logits, batch[label_key])
+        return loss, new_stats
+
+    if remat:
+        forward = jax.checkpoint(forward)
+
+    grad_fn = jax.value_and_grad(forward, has_aux=True)
+
+    def step_fn(state: TrainState, batch):
+        if grad_accum == 1:
+            (loss, new_stats), grads = grad_fn(state.params, state.batch_stats, batch)
+        else:
+            def micro(carry, mb):
+                gsum, stats, lsum = carry
+                (l, stats), g = grad_fn(state.params, stats, mb)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (gsum, stats, lsum + l), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (gsum, new_stats, lsum), _ = jax.lax.scan(
+                micro, (zeros, state.batch_stats, jnp.zeros((), jnp.float32)), batch
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
+            loss = lsum / grad_accum
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        # loss is the global-batch mean — the in-graph equivalent of the
+        # reference's post-step reduce_loss (main.py:105)
+        return new_state, {"loss": loss}
+
+    repl = mesh_lib.replicated_sharding(mesh)
+    if grad_accum == 1:
+        batch_sh = lambda x: NamedSharding(mesh, P(batch_axes, *([None] * (x.ndim - 1))))
+    else:
+        batch_sh = lambda x: NamedSharding(
+            mesh, P(None, batch_axes, *([None] * (x.ndim - 2)))
+        )
+
+    def stage(batch):
+        """Host batch (flat leading dim [global_batch, ...]) → device batch.
+
+        With grad accumulation the flat dim is folded to
+        ``[grad_accum, micro, ...]`` *before* staging, so each device keeps
+        contiguous rows of every microbatch and no resharding is needed.
+        """
+        out = {}
+        for k, v in batch.items():
+            if isinstance(v, jax.Array):
+                out[k] = v
+                continue
+            v = np.asarray(v)
+            if grad_accum > 1:
+                v = v.reshape(grad_accum, -1, *v.shape[1:])
+            if jax.process_count() == 1:
+                out[k] = jax.device_put(v, batch_sh(v))
+            else:
+                # each process holds its own shard of the global batch;
+                # assemble the logical global array (multi-host path)
+                out[k] = jax.make_array_from_process_local_data(batch_sh(v), v)
+        return out
+
+    def compiled(state, batch):
+        return _jitted(state, stage(batch))
+
+    _jitted = jax.jit(step_fn, out_shardings=(repl, repl), donate_argnums=(0,))
+    compiled.jitted = _jitted
+    compiled.stage = stage
+    return compiled
+
+
+def fit(
+    model,
+    tx: optax.GradientTransformation,
+    train_loader,
+    *,
+    epochs: int,
+    mesh: Mesh | None = None,
+    seed: int = 0,
+    job_id: str = "Job0",
+    batch_size: int | None = None,
+    world_size: int | None = None,
+    global_rank: int | None = None,
+    loss_fn: Callable = cross_entropy_loss,
+    input_key: str = "image",
+    label_key: str = "label",
+    grad_accum: int = 1,
+    profile: bool = True,
+    prefetch_depth: int = 2,
+    log_dir: str = ".",
+    metrics_logger: MetricsLogger | None = None,
+) -> tuple[TrainState, list[float]]:
+    """The reference's whole training program (/root/reference/main.py:86-117)
+    as a function: epochs × batches, per-epoch sampler re-shuffle, windowed
+    profiler, TSV metrics, TrainTime footer. Returns final state and the
+    per-step loss history.
+    """
+    from tpudist.data.loader import prefetch_to_mesh
+
+    mesh = mesh or mesh_lib.create_mesh()
+    world_size = world_size if world_size is not None else jax.device_count()
+    global_rank = (
+        global_rank if global_rank is not None else jax.process_index()
+    )
+    if batch_size is None:
+        # loader batch is per-process; the logged batch_size is per-replica
+        # (the reference's per-GPU --batch_size, main.py:25)
+        batch_size = train_loader.batch_size // jax.local_device_count()
+
+    sample = next(iter(train_loader))
+    state = create_train_state(
+        model, seed, jnp.asarray(sample[input_key][:1]), tx, mesh
+    )
+    step = make_train_step(
+        model, tx, mesh,
+        loss_fn=loss_fn, input_key=input_key, label_key=label_key,
+        grad_accum=grad_accum,
+    )
+
+    logger = metrics_logger or MetricsLogger(
+        job_id, batch_size, global_rank, world_size, log_dir=log_dir
+    )
+    losses: list[float] = []
+    with WindowedProfiler(job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}") as p:
+        print("Start")
+        global_step = 0
+        logger.start_timer()
+        for e in range(epochs):
+            train_loader.sampler.set_epoch(e)
+            for idx, batch in enumerate(
+                prefetch_to_mesh(
+                    iter(train_loader), mesh,
+                    depth=prefetch_depth, stage_fn=step.stage,
+                )
+            ):
+                start = time.time()
+                global_step += 1
+                state, metrics = step(state, batch)
+                loss_value = float(metrics["loss"])  # syncs the step
+                losses.append(loss_value)
+                logger.log_step(global_step, loss_value, time.time() - start)
+                logger.print_progress(e, idx, loss_value)
+                p.step()
+        logger.finish()
+    return state, losses
+
+
+def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
+             *, input_key: str = "image", label_key: str = "label") -> float:
+    """Top-1 accuracy over a loader — the reference's dormant eval pass
+    (/root/reference/main.py:119-130), alive and tested here."""
+    mesh = mesh or mesh_lib.create_mesh()
+    repl = mesh_lib.replicated_sharding(mesh)
+
+    @jax.jit
+    def count_correct(params, batch_stats, batch):
+        variables = {"params": params, "batch_stats": batch_stats}
+        logits = model.apply(variables, batch[input_key], train=False)
+        return jnp.sum(jnp.argmax(logits, axis=-1) == batch[label_key])
+
+    cnt, total = 0, 0
+    for batch in loader:
+        batch = mesh_lib.shard_batch(batch, mesh)
+        cnt += int(count_correct(state.params, state.batch_stats, batch))
+        total += int(batch[label_key].shape[0])
+    return cnt / max(total, 1)

@@ -1,0 +1,3 @@
+from tpudist.utils.tree import tree_size, tree_bytes
+
+__all__ = ["tree_size", "tree_bytes"]

@@ -1,0 +1,14 @@
+"""Small pytree utilities."""
+
+from __future__ import annotations
+
+import jax
+
+
+def tree_size(tree) -> int:
+    """Total number of parameters in a pytree."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
