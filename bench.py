@@ -1,0 +1,78 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec) on the
+attached TPU chip(s).
+
+Measures the full tpudist DP train step (forward + backward + Adam + BN,
+bf16 compute) on synthetic ImageNet-shaped data, the BASELINE.json headline
+("images/sec/chip (ResNet-50 ImageNet)"). The reference publishes no
+absolute numbers (BASELINE.md: `published: {}`); the north star is ≥90% of
+an 8×A100 NCCL rig's per-chip rate. vs_baseline is reported against that
+target using 2250 img/s/chip (90% of ~2500 img/s for ResNet-50 mixed
+precision on one A100), so vs_baseline ≥ 1.0 means the target is met.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+TARGET_IMG_PER_SEC_PER_CHIP = 2250.0
+
+
+def main() -> None:
+    from tpudist import mesh as mesh_lib
+    from tpudist.models import resnet50
+    from tpudist.train import create_train_state, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    per_chip_batch = 256  # swept 64/128/256/512 on v5e: 256 peaks
+    batch = per_chip_batch * n_chips
+
+    model = resnet50(dtype=jnp.bfloat16)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 224, 224, 3)), tx, mesh)
+    step = make_train_step(model, tx, mesh)
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    host_batch = {
+        "image": rng.random((batch, 224, 224, 3), np.float32),
+        "label": rng.integers(0, 1000, batch).astype(np.int32),
+    }
+    dev_batch = step.stage(host_batch)
+
+    # warmup (compile + 2 steps)
+    for _ in range(3):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * n_steps / dt
+    img_per_sec_per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(img_per_sec_per_chip, 2),
+                "unit": "images/sec/chip (bf16, batch 256/chip, 224x224)",
+                "vs_baseline": round(img_per_sec_per_chip / TARGET_IMG_PER_SEC_PER_CHIP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

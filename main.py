@@ -74,12 +74,12 @@ def main(argv=None):
     mesh = create_mesh()
 
     # --- dataset (reference: CIFAR-100 with ToTensor only, main.py:42-51) ---
+    # note: the model head deliberately stays 1000-way regardless of the
+    # dataset's class count — the reference does not adapt it (main.py:40)
     if args.dataset == "synthetic":
-        num_classes_data = 100
-        data = synthetic_cifar(args.synthetic_size, num_classes=num_classes_data)
+        data = synthetic_cifar(args.synthetic_size, num_classes=100)
     else:
         data = load_cifar(args.data_root, dataset=args.dataset, train=True)
-        num_classes_data = 100 if args.dataset == "cifar100" else 10
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     # reference keeps the stock 1000-way head even on CIFAR (main.py:40)

@@ -20,7 +20,6 @@ the test suite and ``--synthetic`` mode.
 
 from __future__ import annotations
 
-import hashlib
 import os
 import pickle
 import tarfile
@@ -60,9 +59,14 @@ def _download(root: Path, spec: dict) -> None:
         root.mkdir(parents=True, exist_ok=True)
         tar_path = root / Path(spec["url"]).name
         if not tar_path.exists():
+            # download to a temp name then rename, so an interrupted fetch
+            # can't leave a truncated tarball that poisons every later run
+            tmp_path = tar_path.with_suffix(".tmp")
             try:
-                urllib.request.urlretrieve(spec["url"], tar_path)
+                urllib.request.urlretrieve(spec["url"], tmp_path)
+                tmp_path.rename(tar_path)
             except OSError as e:
+                tmp_path.unlink(missing_ok=True)
                 raise RuntimeError(
                     f"could not download {spec['url']} ({e}). Either place "
                     f"the extracted dataset at {root / spec['dirname']}, or "

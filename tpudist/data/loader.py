@@ -88,13 +88,16 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
     host_q: collections.deque = collections.deque()
     lock = threading.Condition()
     DONE = object()
+    abandoned = False  # set when the consumer drops the generator early
 
     def _producer():
         try:
             for item in iterator:
                 with lock:
-                    while len(host_q) >= depth + 1:
+                    while len(host_q) >= depth + 1 and not abandoned:
                         lock.wait()
+                    if abandoned:
+                        return
                     host_q.append(item)
                     lock.notify_all()
         except BaseException as e:  # surface loader errors to the consumer
@@ -122,14 +125,21 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
     if stage_fn is None:
         stage_fn = lambda b: shard_batch(b, mesh)
 
-    finished = False
-    while True:
-        while not finished and len(queue) < depth:
-            item = _next_host()
-            if item is DONE:
-                finished = True
-            else:
-                queue.append(stage_fn(item))
-        if not queue:
-            return
-        yield queue.popleft()
+    try:
+        finished = False
+        while True:
+            while not finished and len(queue) < depth:
+                item = _next_host()
+                if item is DONE:
+                    finished = True
+                else:
+                    queue.append(stage_fn(item))
+            if not queue:
+                return
+            yield queue.popleft()
+    finally:
+        # unblock and retire the producer if the consumer bailed mid-epoch
+        with lock:
+            abandoned = True
+            host_q.clear()
+            lock.notify_all()

@@ -88,21 +88,29 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, _kill)
     rc = 0
     try:
-        for p in procs:
-            p.wait()
-            rc = rc or p.returncode
+        # poll all children: the first non-zero exit terminates the rest so
+        # a dead rank can't leave the world hung in a collective
+        # (SURVEY.md §5 failure detection: static world, fail-fast)
+        import time as _time
+
+        live = list(procs)
+        while live:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                if code != 0 and rc == 0:
+                    rc = code
+                    for q in live:
+                        q.terminate()
+            if live:
+                _time.sleep(0.2)
     except KeyboardInterrupt:
         _kill(None, None)
         for p in procs:
             p.wait()
         rc = 130
-    if rc:
-        # fail-fast semantics: if any rank failed, reap the rest so the
-        # world doesn't hang half-formed (SURVEY.md §5 failure detection:
-        # static world, fail-fast on loss of a member)
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
     return rc
 
 

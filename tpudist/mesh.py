@@ -125,19 +125,26 @@ def global_batch_sizes(
     return per_replica, per_process
 
 
-def shard_batch(batch, mesh: Mesh):
-    """Place a host-local batch (numpy pytree) onto the mesh, sharded over the
-    batch dimension.
+def put_sharded(x: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """Stage one host-local array under ``sharding``.
 
-    Single-process: a plain sharded ``device_put``. Multi-process: each host
-    contributes its local shard and the result is the global logical array —
-    the TPU-native equivalent of every DDP rank holding its own minibatch.
+    Single-process: a plain sharded ``device_put``. Multi-process: this
+    process contributes its local shard and the result is the global logical
+    array — the TPU-native equivalent of every DDP rank holding its own
+    minibatch.
     """
-    def _put(x):
-        x = np.asarray(x)
-        sharding = batch_sharding(mesh, extra_dims=x.ndim - 1)
-        if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, x)
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, x)
 
-    return jax.tree_util.tree_map(_put, batch)
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host-local batch (numpy pytree) onto the mesh, sharded over
+    the batch dimension."""
+    return jax.tree_util.tree_map(
+        lambda x: put_sharded(
+            np.asarray(x), batch_sharding(mesh, extra_dims=np.ndim(x) - 1)
+        ),
+        batch,
+    )

@@ -49,7 +49,16 @@ class WindowedProfiler:
         self._tracing = False
 
     def __enter__(self):
+        # wait+warmup == 0 means "capture from the first step" — the window
+        # must open before any step() call
+        if self.enabled and self.repeat > 0 and self.skip == 0:
+            self._start()
         return self
+
+    def _start(self) -> None:
+        Path(self.log_dir).mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self._tracing = True
 
     def step(self) -> None:
         """Advance the schedule; call once per training iteration
@@ -57,13 +66,12 @@ class WindowedProfiler:
         if not self.enabled or self._cycle >= self.repeat:
             return
         self._step += 1
-        if not self._tracing and self._step == self.skip:
-            Path(self.log_dir).mkdir(parents=True, exist_ok=True)
-            jax.profiler.start_trace(self.log_dir)
-            self._tracing = True
-            self._window_end = self._step + self.active
-        elif self._tracing and self._step >= self._window_end:
+        if self._tracing and self._step >= self.skip + self.active:
             self._stop()
+            if self._cycle < self.repeat and self.skip == 0:
+                self._start()
+        elif not self._tracing and self._step == self.skip:
+            self._start()
 
     def _stop(self) -> None:
         # block_until_ready is implicit: stop_trace flushes what the runtime

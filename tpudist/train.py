@@ -169,8 +169,9 @@ def make_train_step(
 
     repl = mesh_lib.replicated_sharding(mesh)
     if grad_accum == 1:
-        batch_sh = lambda x: NamedSharding(mesh, P(batch_axes, *([None] * (x.ndim - 1))))
+        batch_sh = lambda x: mesh_lib.batch_sharding(mesh, extra_dims=x.ndim - 1)
     else:
+        # leading microbatch dim replicated (scanned over), second dim sharded
         batch_sh = lambda x: NamedSharding(
             mesh, P(None, batch_axes, *([None] * (x.ndim - 2)))
         )
@@ -190,12 +191,7 @@ def make_train_step(
             v = np.asarray(v)
             if grad_accum > 1:
                 v = v.reshape(grad_accum, -1, *v.shape[1:])
-            if jax.process_count() == 1:
-                out[k] = jax.device_put(v, batch_sh(v))
-            else:
-                # each process holds its own shard of the global batch;
-                # assemble the logical global array (multi-host path)
-                out[k] = jax.make_array_from_process_local_data(batch_sh(v), v)
+            out[k] = mesh_lib.put_sharded(v, batch_sh(v))
         return out
 
     def compiled(state, batch):
@@ -259,7 +255,11 @@ def fit(
         job_id, batch_size, global_rank, world_size, log_dir=log_dir
     )
     losses: list[float] = []
-    with WindowedProfiler(job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}") as p:
+    # logger as context manager: the TrainTime footer is written even if a
+    # step raises mid-training
+    with logger, WindowedProfiler(
+        job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}"
+    ) as p:
         print("Start")
         global_step = 0
         logger.start_timer()
@@ -279,7 +279,6 @@ def fit(
                 logger.log_step(global_step, loss_value, time.time() - start)
                 logger.print_progress(e, idx, loss_value)
                 p.step()
-        logger.finish()
     return state, losses
 
 
