@@ -5,6 +5,13 @@ No reference counterpart (SURVEY.md §2.12); built for the LM leg of the
 baseline ladder. TPU-first: causal attention through tpudist.ops (XLA or
 Pallas flash path), bf16 compute with fp32 params, weight-tied LM head as a
 single MXU matmul against the embedding table.
+
+Tensor parallelism is expressed as Megatron-style param partitioning
+metadata over the ``tensor`` mesh axis (``nn.with_partitioning``): qkv and
+mlp_fc are column-parallel (heads / ffn dim sharded), out and mlp_proj are
+row-parallel, and the embedding table is vocab-sharded. GSPMD inserts the
+pair of all-reduces per block from these shardings — there is no hand-written
+collective. On a mesh with ``tensor=1`` the metadata is inert.
 """
 
 from __future__ import annotations
@@ -14,7 +21,9 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
+from tpudist.mesh import TENSOR_AXIS
 from tpudist.ops.attention import multi_head_attention
+from tpudist.parallel.tp import partitioned
 
 
 class Block(nn.Module):
@@ -26,16 +35,33 @@ class Block(nn.Module):
     def __call__(self, x):
         b, s, d = x.shape
         h = self.num_heads
+        dense_init = nn.initializers.lecun_normal()
         y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
-        qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(y)
+        # column-parallel: head dim sharded over 'tensor'
+        qkv = nn.DenseGeneral(
+            (3, h, d // h), dtype=self.dtype, name="qkv",
+            kernel_init=partitioned(dense_init, None, None, TENSOR_AXIS, None),
+            bias_init=partitioned(nn.initializers.zeros_init(), None, TENSOR_AXIS, None),
+        )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
-        y = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(attn)
+        # row-parallel: contraction dim sharded; GSPMD all-reduces the output
+        y = nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, name="out",
+            kernel_init=partitioned(dense_init, TENSOR_AXIS, None, None),
+        )(attn)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
-        y = nn.Dense(4 * d, dtype=self.dtype, name="mlp_fc")(y)
+        y = nn.Dense(
+            4 * d, dtype=self.dtype, name="mlp_fc",
+            kernel_init=partitioned(dense_init, None, TENSOR_AXIS),
+            bias_init=partitioned(nn.initializers.zeros_init(), TENSOR_AXIS),
+        )(y)
         y = nn.gelu(y)
-        y = nn.Dense(d, dtype=self.dtype, name="mlp_proj")(y)
+        y = nn.Dense(
+            d, dtype=self.dtype, name="mlp_proj",
+            kernel_init=partitioned(dense_init, TENSOR_AXIS, None),
+        )(y)
         return x + y
 
 
@@ -52,7 +78,9 @@ class GPT2(nn.Module):
     def __call__(self, tokens, train: bool = True):
         b, s = tokens.shape
         wte = self.param(
-            "wte", nn.initializers.normal(0.02), (self.vocab_size, self.hidden_dim), jnp.float32
+            "wte",
+            partitioned(nn.initializers.normal(0.02), TENSOR_AXIS, None),
+            (self.vocab_size, self.hidden_dim), jnp.float32,
         )
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (self.max_seq_len, self.hidden_dim), jnp.float32

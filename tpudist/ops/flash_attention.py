@@ -1,0 +1,253 @@
+"""Flash attention as a Pallas TPU kernel.
+
+No reference counterpart (the reference's workload is a CNN, SURVEY.md §5
+"long-context: ABSENT") — this is the hot op for the transformer legs of the
+BASELINE ladder (ViT, GPT-2) and the building block the ring-attention
+context-parallel path reuses blockwise.
+
+Design (FlashAttention-2 style, TPU-first):
+
+- grid ``(batch, heads, q_blocks, k_blocks)`` with the K dimension innermost,
+  so the f32 VMEM scratch accumulators (running max ``m``, normalizer ``l``,
+  output ``acc``) persist across the K sweep of one Q block;
+- per tile: one MXU matmul ``q·kᵀ`` (f32 accumulation), online-softmax
+  rescale on the VPU, one MXU matmul ``p·v`` into the accumulator — the
+  S×S score matrix never exists in HBM;
+- causal masking is two-level: whole K blocks strictly above the diagonal are
+  predicated off with ``pl.when`` (no MXU work issued), the diagonal block is
+  masked elementwise with ``broadcasted_iota``;
+- the backward pass is a blockwise ``lax.scan`` in plain JAX using the saved
+  log-sum-exp — memory stays O(S·block) and XLA fuses it; a dedicated Pallas
+  backward kernel is a later optimization.
+
+Numerics: scores/softmax in float32 regardless of input dtype (bf16 in, bf16
+out). Matches ``dot_product_attention`` to ~1e-2 in bf16, ~1e-5 in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    # CPU (tests, 8-fake-device mesh) has no Mosaic backend; interpret there.
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref,  # [1,1,bq,d], [1,1,bk,d], [1,1,bk,d]
+    o_ref, lse_ref,       # [1,1,bq,d], [1,1,bq]
+    m_scr, l_scr, acc_scr,  # VMEM f32: [bq,128], [bq,128], [bq,d]
+    *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: K blocks strictly above the diagonal contribute nothing; skip
+    # them entirely (predicated off — no MXU work issued).
+    block_relevant = True
+    if causal:
+        block_relevant = ki * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(block_relevant)
+    def _compute():
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        # rows with nothing unmasked yet keep m = NEG_INF; exp underflows to 0
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1] rescale of history
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        # guard fully-masked rows (can't happen for causal with bq>=1, but
+        # keeps the kernel total-function)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse = m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
+        lse_ref[0, 0] = lse
+
+
+def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
+    """q,k,v: [B, H, S, D] → (o [B,H,S,D], lse [B,H,S] f32)."""
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    # TPU tile constraint: last-two dims of every VMEM block must align to
+    # (8,128)/(16,128); requiring 128-multiples keeps the MXU fully fed.
+    # Non-conforming shapes fall back to XLA attention (ops/attention.py).
+    if s_q % block_q or s_k % block_k or block_q % 128 or block_k % 128:
+        raise NotImplementedError(
+            f"flash attention needs 128-aligned blocks: seq_q={s_q}, "
+            f"seq_k={s_k}, block_q={block_q}, block_k={block_k}"
+        )
+    grid = (b, h, s_q // block_q, s_k // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((b, h, s_q), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_blockwise(res, g, *, causal, sm_scale, block_k):
+    """Blockwise backward from saved (q,k,v,o,lse): lax.scan over K blocks.
+
+    Standard flash backward identities with the row log-sum-exp:
+      p   = exp(q·kᵀ·scale − lse)
+      dv  = pᵀ·do
+      dp  = do·vᵀ;  δ = rowsum(do ∘ o)
+      ds  = p ∘ (dp − δ) · scale
+      dq  = Σ_blocks ds·k;   dk = dsᵀ·q
+    Never materializes more than [S_q, block_k] of p/ds.
+    """
+    q, k, v, o, lse = res
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    block_k = min(block_k, s_k)
+    nk = s_k // block_k
+
+    qf = q.astype(jnp.float32)
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True)  # [b,h,sq,1]
+    lse_e = lse[..., None]  # [b,h,sq,1]
+    q_pos = jnp.arange(s_q)[:, None]
+
+    # [nk, b, h, block_k, d] scan layout
+    kb = k.astype(jnp.float32).reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.astype(jnp.float32).reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    def one_block(dq_acc, inp):
+        ki, kblk, vblk = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk) * sm_scale
+        if causal:
+            k_pos = ki * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_e)                     # [b,h,sq,bk]
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, do)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vblk)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((b, h, s_q, d), jnp.float32)
+    dq, (dk, dv) = jax.lax.scan(one_block, dq0, (jnp.arange(nk), kb, vb))
+    dk = dk.transpose(1, 2, 0, 3, 4).reshape(b, h, s_k, d)
+    dv = dv.transpose(1, 2, 0, 3, 4).reshape(b, h, s_k, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _flash_fwd(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k,
+    )
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, res, g):
+    return _bwd_blockwise(res, g, causal=causal, sm_scale=sm_scale, block_k=block_k)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+):
+    """Flash attention on [B, S, H, D] inputs (same layout as
+    :func:`tpudist.ops.attention.dot_product_attention`)."""
+    if q.ndim != 4:
+        raise NotImplementedError(f"expected [B,S,H,D], got {q.shape}")
+    d = q.shape[-1]
+    sm_scale = 1.0 / float(np.sqrt(d))
+    # Pad head_dim to the 128-lane tile. Zero-padded q/k leave scores
+    # unchanged; padded v columns produce output columns sliced off below.
+    d_pad = -d % 128
+    if d_pad:
+        pad = [(0, 0)] * 3 + [(0, d_pad)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    # [B,S,H,D] → [B,H,S,D] for contiguous per-head tiles
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o = _flash(qt, kt, vt, causal, sm_scale, block_q, block_k)
+    return o.transpose(0, 2, 1, 3)[..., :d]

@@ -1,0 +1,24 @@
+"""Tensor parallelism — Megatron-style sharded layers via GSPMD.
+
+No reference counterpart (SURVEY.md §2.12: DP is the only strategy there);
+built so the framework scales models past one chip's HBM. The design is
+sharding-metadata-only: layers annotate their params with
+``nn.with_partitioning`` over the ``tensor`` mesh axis (see
+``tpudist.models.gpt2`` for the canonical annotation: qkv/mlp_fc
+column-parallel, out/mlp_proj row-parallel, vocab-sharded embedding), and
+``tpudist.train.create_train_state``/``make_train_step`` turn that metadata
+into NamedShardings. XLA then derives the per-block all-reduces and overlaps
+them with compute — no hand-written collective, and composition with
+data/sequence axes falls out of the mesh.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+
+def partitioned(init, *dim_axes):
+    """Annotate a param initializer with one mesh-axis name (or None) per
+    kernel dimension, e.g. ``partitioned(init, None, None, TENSOR_AXIS, None)``
+    for a column-parallel qkv kernel of shape [d, 3, heads, head_dim]."""
+    return nn.with_partitioning(init, dim_axes)

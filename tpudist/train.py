@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from flax import linen as nn
 from flax import struct
 from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -67,15 +68,23 @@ def create_train_state(
     tx: optax.GradientTransformation,
     mesh: Mesh | None = None,
 ) -> TrainState:
-    """Initialize params/opt state, replicated over the mesh.
+    """Initialize params/opt state on the mesh.
 
-    Same seed on every process ⇒ bit-identical replicated params — the
-    TPU-native init-sync replacing DDP's rank-0 broadcast (SURVEY.md §2.5).
+    Placement follows the model's ``nn.with_partitioning`` metadata:
+    metadata-free models (ResNet, ViT — the DDP model) come out fully
+    replicated; annotated models (GPT-2's Megatron specs) come out sharded,
+    with the optimizer's params-shaped mirrors sharded to match.
+
+    Same seed on every process ⇒ bit-identical params — the TPU-native
+    init-sync replacing DDP's rank-0 broadcast (SURVEY.md §2.5).
     """
     if isinstance(rng, int):
         rng = jax.random.key(rng)
 
-    def _init():
+    def _boxed():
+        # params stay in their nn.Partitioned boxes through tx.init, so the
+        # optimizer's params-shaped mirrors (adam mu/nu) carry the same
+        # partitioning metadata — the sharding tree below covers them too
         variables = model.init(rng, sample_input, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", FrozenDict())
@@ -86,10 +95,30 @@ def create_train_state(
             opt_state=tx.init(params),
         )
 
+    def _init():
+        return nn.meta.unbox(_boxed())
+
     if mesh is None:
         return jax.jit(_init)()
-    repl = mesh_lib.replicated_sharding(mesh)
-    return jax.jit(_init, out_shardings=repl)()
+    return jax.jit(_init, out_shardings=state_shardings_from_meta(_boxed, mesh))()
+
+
+def state_shardings_from_meta(boxed_init_fn, mesh: Mesh):
+    """TrainState-shaped tree of NamedShardings from ``nn.with_partitioning``
+    metadata (unannotated leaves → replicated). The tree matches the
+    *unboxed* state, which is what ``nn.get_partition_spec`` returns."""
+    specs = nn.get_partition_spec(jax.eval_shape(boxed_init_fn))
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def state_shardings_of(state: TrainState):
+    """The concrete sharding of every leaf of a placed TrainState — pass to
+    :func:`make_train_step` as ``state_sharding`` for TP/FSDP runs."""
+    return jax.tree_util.tree_map(lambda x: x.sharding, state)
 
 
 def make_train_step(
@@ -102,8 +131,13 @@ def make_train_step(
     label_key: str = "label",
     grad_accum: int = 1,
     remat: bool = False,
+    state_sharding=None,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
+
+    ``state_sharding``: a TrainState-shaped pytree of NamedShardings (see
+    :func:`state_shardings_of`) for TP/FSDP runs where params are NOT fully
+    replicated; defaults to the replicated DDP model.
 
     ``grad_accum > 1`` scans over ``grad_accum`` microbatches
     (batch leading dims ``[grad_accum, micro_batch, ...]``, microbatch dim
@@ -168,6 +202,7 @@ def make_train_step(
         return new_state, {"loss": loss}
 
     repl = mesh_lib.replicated_sharding(mesh)
+    out_state_sharding = state_sharding if state_sharding is not None else repl
     if grad_accum == 1:
         batch_sh = lambda x: mesh_lib.batch_sharding(mesh, extra_dims=x.ndim - 1)
     else:
@@ -197,7 +232,9 @@ def make_train_step(
     def compiled(state, batch):
         return _jitted(state, stage(batch))
 
-    _jitted = jax.jit(step_fn, out_shardings=(repl, repl), donate_argnums=(0,))
+    _jitted = jax.jit(
+        step_fn, out_shardings=(out_state_sharding, repl), donate_argnums=(0,)
+    )
     compiled.jitted = _jitted
     compiled.stage = stage
     return compiled
@@ -249,6 +286,10 @@ def fit(
         model, tx, mesh,
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum,
+        # keep whatever sharding create_train_state produced (replicated for
+        # plain DP, sharded for TP-annotated models) — forcing replicated
+        # here would all-gather a TP model's params on the first step
+        state_sharding=state_shardings_of(state),
     )
 
     logger = metrics_logger or MetricsLogger(
