@@ -1,0 +1,251 @@
+"""Tests for the native (C++) core: batch gather and the TCP store.
+
+The reference delegates batch assembly and rendezvous to upstream C++
+(DataLoader worker pool, c10d TCPStore — SURVEY.md §2.3/§2.7); these tests
+pin tpudist's own native equivalents against the pure-Python semantics.
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from tpudist import csrc
+
+
+pytestmark = pytest.mark.skipif(
+    csrc.lib() is None, reason="native library unavailable (no C++ toolchain)"
+)
+
+
+# ---------------------------------------------------------------- batcher
+def test_gather_matches_numpy_all_dtypes():
+    from tpudist.data.native import NativeBatcher
+
+    b = NativeBatcher(2)
+    rng = np.random.Generator(np.random.PCG64(0))
+    idx = rng.integers(0, 500, 97)
+    for dtype, shape in [
+        (np.uint8, (500, 32, 32, 3)),
+        (np.float32, (500, 17)),
+        (np.int32, (500,)),
+        (np.int64, (500, 3, 5)),
+    ]:
+        src = rng.integers(0, 100, shape).astype(dtype)
+        np.testing.assert_array_equal(b.gather(src, idx), src[idx])
+    b.close()
+
+
+def test_fused_gather_matches_to_tensor():
+    from tpudist.data.cifar import to_tensor
+    from tpudist.data.native import NativeBatcher
+
+    b = NativeBatcher(2)
+    rng = np.random.Generator(np.random.PCG64(1))
+    src = rng.integers(0, 256, (300, 32, 32, 3)).astype(np.uint8)
+    idx = rng.integers(0, 300, 64)
+    fused = b.gather_u8_to_f32(src, idx, *to_tensor.native_spec["image"])
+    ref = to_tensor({"image": src[idx]})["image"]
+    assert fused.dtype == np.float32
+    np.testing.assert_allclose(fused, ref, rtol=0, atol=1e-7)
+    b.close()
+
+
+def test_gather_large_parallel_path():
+    # large enough to split across threads (>1 MiB of rows)
+    from tpudist.data.native import NativeBatcher
+
+    b = NativeBatcher(4)
+    rng = np.random.Generator(np.random.PCG64(2))
+    src = rng.integers(0, 256, (2048, 3072)).astype(np.uint8)
+    idx = rng.permutation(2048)
+    np.testing.assert_array_equal(b.gather(src, idx), src[idx])
+    out = b.gather_u8_to_f32(src, idx, 2.0, -1.0)
+    np.testing.assert_allclose(out, src[idx].astype(np.float32) * 2.0 - 1.0)
+    b.close()
+
+
+def test_dataloader_native_equals_python():
+    """The C++ fast path must be batch-for-batch identical to the numpy
+    path (same sampler order, same values)."""
+    from tpudist.data.cifar import synthetic_cifar, to_tensor
+    from tpudist.data.loader import DataLoader
+    from tpudist.data.sampler import DistributedSampler
+
+    data = synthetic_cifar(n=257, num_classes=10)
+    mk = lambda native: DataLoader(
+        data, 32,
+        sampler=DistributedSampler(257, num_replicas=2, rank=1, seed=3),
+        transform=to_tensor, native=native,
+    )
+    for b_native, b_py in zip(mk(True), mk(False)):
+        assert b_native.keys() == b_py.keys()
+        for k in b_py:
+            np.testing.assert_allclose(b_native[k], b_py[k], atol=1e-7)
+
+
+def test_dataloader_falls_back_on_opaque_transform():
+    """A transform without native_spec must still be applied (Python path)."""
+    from tpudist.data.cifar import synthetic_cifar
+    from tpudist.data.loader import DataLoader
+
+    data = synthetic_cifar(n=64, num_classes=10)
+    flip = lambda b: {**b, "image": b["image"][:, :, ::-1]}
+    batch = next(iter(DataLoader(data, 16, transform=flip, native=True)))
+    assert batch["image"].dtype == np.uint8  # transform ran, no f32 conversion
+
+
+# ---------------------------------------------------------------- TCP store
+def test_store_set_get_add():
+    from tpudist.store import TCPStore
+
+    with TCPStore("127.0.0.1", 0, world_size=1, rank=0) as s:
+        s.set("alpha", b"1")
+        assert s.get("alpha") == b"1"
+        s.set("alpha", "two")  # str convenience + overwrite
+        assert s.get("alpha") == b"two"
+        assert s.get("nope", wait=False) is None
+        assert s.get("nope", timeout_ms=50) is None  # bounded wait
+        assert s.add("n", 10) == 10
+        assert s.add("n", -3) == 7
+        assert s.get("n") == b"7"  # ADD/GET interop
+
+
+def test_store_two_clients_wait():
+    """A GET with a wait blocks until another client SETs the key."""
+    import threading
+
+    from tpudist.store import TCPStore
+
+    with TCPStore("127.0.0.1", 0, world_size=1, rank=0) as server:
+        other = TCPStore("127.0.0.1", server.port, world_size=1, rank=1,
+                         is_server=False)
+        got = {}
+
+        def waiter():
+            got["v"] = server.get("late-key", timeout_ms=5000)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        other.set("late-key", b"worth-the-wait")
+        t.join(timeout=10)
+        assert got["v"] == b"worth-the-wait"
+        other.close()
+
+
+def _store_worker(rank, world, port, q):
+    from tpudist.store import TCPStore
+
+    store = TCPStore("127.0.0.1", port, world_size=world, rank=rank,
+                     is_server=False, timeout_ms=20_000)
+    n = store.add("hits", 1)
+    store.barrier("all-in")
+    # after the barrier every rank must observe the full count
+    total = int(store.get("hits"))
+    q.put((rank, n, total))
+    store.close()
+
+
+def test_store_multiprocess_barrier():
+    """4 real processes rendezvous on the store — the env:// pattern
+    (/root/reference/README.md:17-35) without any JAX involvement."""
+    from tpudist.store import TCPStore
+
+    world = 4
+    server = TCPStore("127.0.0.1", 0, world_size=world, rank=0)
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_store_worker, args=(r, world, server.port, q))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    assert sorted(n for _, n, _ in results) == [1, 2, 3, 4]
+    assert all(total == world for _, _, total in results)
+    server.close()
+
+
+def test_store_barrier_timeout():
+    from tpudist.store import TCPStore
+
+    with TCPStore("127.0.0.1", 0, world_size=2, rank=0) as s:
+        with pytest.raises(TimeoutError):
+            s.barrier("lonely", timeout_ms=100)
+
+
+def test_gather_index_semantics():
+    """Negative indices wrap (numpy semantics); out-of-range raises instead
+    of reading out-of-bounds memory; non-contiguous sources are refused."""
+    from tpudist.data.native import NativeBatcher
+
+    b = NativeBatcher(1)
+    src = np.arange(50, dtype=np.int64).reshape(10, 5)
+    np.testing.assert_array_equal(b.gather(src, np.array([-1, -10, 3])),
+                                  src[[-1, -10, 3]])
+    with pytest.raises(IndexError):
+        b.gather(src, np.array([10]))
+    with pytest.raises(IndexError):
+        b.gather(src, np.array([-11]))
+    with pytest.raises(ValueError):
+        b.gather(np.asfortranarray(np.zeros((4, 4))), np.array([0]))
+    b.close()
+
+
+def test_native_batch_falls_back_on_non_u8_image():
+    """A spec'd key with the wrong dtype must fall back to the Python path
+    (which applies the transform) — not silently skip the conversion."""
+    from tpudist.data.cifar import to_tensor
+    from tpudist.data.loader import DataLoader
+
+    data = {
+        "image": np.full((64, 8, 8, 3), 255.0, np.float32),  # not uint8
+        "label": np.zeros(64, np.int32),
+    }
+    batch = next(iter(DataLoader(data, 16, transform=to_tensor, native=True)))
+    np.testing.assert_allclose(batch["image"], 1.0)  # /255 was applied
+
+
+def test_store_barrier_reusable():
+    """The same barrier name must re-synchronize on every use, not become a
+    no-op after the first generation's done-key persists."""
+    from tpudist.store import TCPStore
+
+    with TCPStore("127.0.0.1", 0, world_size=2, rank=0) as s:
+        import threading
+
+        peer = TCPStore("127.0.0.1", s.port, world_size=2, rank=1,
+                        is_server=False)
+        for _ in range(3):  # three generations of the same name
+            t = threading.Thread(target=peer.barrier, args=("epoch",))
+            t.start()
+            s.barrier("epoch", timeout_ms=5000)
+            t.join(timeout=10)
+            assert not t.is_alive()
+        # a lone arrival at generation 3 must block (not see stale done keys)
+        with pytest.raises(TimeoutError):
+            s.barrier("epoch", timeout_ms=100)
+        peer.close()
+
+
+def test_store_rejects_oversized_value():
+    from tpudist.store import MAX_VALUE_BYTES, TCPStore
+
+    with TCPStore("127.0.0.1", 0, world_size=1, rank=0) as s:
+        with pytest.raises(ValueError):
+            s.set("big", b"x" * (MAX_VALUE_BYTES + 1))
+        s.set("ok", b"still works")  # connection not poisoned
+        assert s.get("ok") == b"still works"
+
+
+def test_store_broadcast():
+    from tpudist.store import TCPStore
+
+    with TCPStore("127.0.0.1", 0, world_size=1, rank=0) as s:
+        assert s.broadcast("cfg", b"payload") == b"payload"   # publisher
+        assert s.broadcast("cfg") == b"payload"               # subscriber

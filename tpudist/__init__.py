@@ -25,6 +25,7 @@ mesh, with XLA inserting and overlapping the ICI/DCN collectives.
 from tpudist.mesh import MeshConfig, create_mesh, batch_sharding, replicated_sharding
 from tpudist.distributed import DistributedContext, init_from_env, reduce_loss
 from tpudist.data.sampler import DistributedSampler
+from tpudist.store import TCPStore
 
 __version__ = "0.1.0"
 
@@ -37,5 +38,6 @@ __all__ = [
     "init_from_env",
     "reduce_loss",
     "DistributedSampler",
+    "TCPStore",
     "__version__",
 ]

@@ -1,0 +1,89 @@
+"""Native (C++) core of tpudist, loaded via ctypes.
+
+The reference's data path and rendezvous are backed by upstream C++
+(DataLoader worker pool / pinned allocator, c10d TCPStore — SURVEY.md §2.3,
+§2.7); this package holds tpudist's own native equivalents. The library is
+compiled lazily on first use (see :mod:`tpudist.csrc.build`); if no
+toolchain is available the callers fall back to pure-Python paths, so the
+framework degrades gracefully rather than hard-requiring a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+ABI_VERSION = 1
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.tpd_abi_version.restype = c.c_int
+    lib.tpd_pool_create.restype = c.c_void_p
+    lib.tpd_pool_create.argtypes = [c.c_int]
+    lib.tpd_pool_destroy.restype = None
+    lib.tpd_pool_destroy.argtypes = [c.c_void_p]
+    lib.tpd_pool_size.restype = c.c_int
+    lib.tpd_pool_size.argtypes = [c.c_void_p]
+    lib.tpd_gather_rows.restype = None
+    lib.tpd_gather_rows.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p, c.c_int64, c.c_void_p,
+    ]
+    lib.tpd_gather_u8_to_f32.restype = None
+    lib.tpd_gather_u8_to_f32.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p, c.c_int64, c.c_void_p,
+        c.c_float, c.c_float,
+    ]
+    # TCP store (tcpstore.cpp)
+    lib.tpd_store_server_create.restype = c.c_void_p
+    lib.tpd_store_server_create.argtypes = [c.c_int]
+    lib.tpd_store_server_port.restype = c.c_int
+    lib.tpd_store_server_port.argtypes = [c.c_void_p]
+    lib.tpd_store_server_destroy.restype = None
+    lib.tpd_store_server_destroy.argtypes = [c.c_void_p]
+    lib.tpd_client_create.restype = c.c_void_p
+    lib.tpd_client_create.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.tpd_client_destroy.restype = None
+    lib.tpd_client_destroy.argtypes = [c.c_void_p]
+    lib.tpd_client_set.restype = c.c_int
+    lib.tpd_client_set.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64,
+    ]
+    lib.tpd_client_get.restype = c.c_int64
+    lib.tpd_client_get.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64, c.c_int,
+    ]
+    lib.tpd_client_add.restype = c.c_int64
+    lib.tpd_client_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded native library, or None if it cannot be built/loaded."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            from tpudist.csrc.build import build
+
+            path = build()
+            loaded = ctypes.CDLL(str(path))
+            _declare(loaded)
+            got = loaded.tpd_abi_version()
+            if got != ABI_VERSION:
+                raise RuntimeError(f"native ABI {got} != expected {ABI_VERSION}")
+            _lib = loaded
+        except Exception as e:  # no toolchain / load failure → Python fallback
+            logger.warning("tpudist native core unavailable (%s); "
+                           "falling back to pure-Python paths", e)
+            _failed = True
+    return _lib

@@ -1,0 +1,61 @@
+"""Lazy in-tree build of the native library.
+
+The reference ships no build system (its native machinery lives in upstream
+torch); tpudist compiles its own C++ core on first use with the toolchain on
+the host and caches the shared object next to the sources, keyed by a hash
+of their content so edits trigger a rebuild and stale objects are never
+loaded. Concurrent builders (multi-process launch) race benignly: each
+builds to a unique temp file and the final ``os.replace`` is atomic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_CSRC = Path(__file__).resolve().parent
+
+CXX = os.environ.get("TPUDIST_CXX", "g++")
+CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+
+
+def sources() -> list[Path]:
+    return sorted(_CSRC.glob("*.cpp"))
+
+
+def build(force: bool = False) -> Path:
+    """Compile (if needed) and return the path of the shared library."""
+    srcs = sources()
+    if not srcs:
+        raise FileNotFoundError(f"no C++ sources under {_CSRC}")
+    h = hashlib.sha256()
+    for s in srcs:
+        h.update(s.name.encode())
+        h.update(s.read_bytes())
+    out = _CSRC / f"libtpudist_{h.hexdigest()[:16]}.so"
+    if out.exists() and not force:
+        return out
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CSRC)
+    os.close(fd)
+    try:
+        cmd = [CXX, *CXXFLAGS, "-o", tmp, *map(str, srcs)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+            )
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    # retire caches of older source versions
+    for old in _CSRC.glob("libtpudist_*.so"):
+        if old != out:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return out

@@ -1,0 +1,339 @@
+// Native TCP key-value store — tpudist's equivalent of c10d's C++ TCPStore,
+// the rendezvous mechanism behind the reference's
+// `dist.init_process_group(init_method='env://')` (/root/reference/main.py:34,
+// SURVEY.md §2.3): rank 0 hosts a TCP store at MASTER_ADDR:MASTER_PORT and
+// every rank connects to exchange bootstrap state and synchronize.
+//
+// jax.distributed owns the *device* bring-up; this store covers host-side
+// coordination that must work before/outside JAX: launcher health checks,
+// the rank-0 dataset-download guard (SURVEY.md §5 race fix), and generic
+// cross-process barriers (built in Python on SET/GET/ADD).
+//
+// Protocol (little-endian, one request/response per message):
+//   SET(1): u32 klen, key, u64 vlen, val          → u8 status
+//   GET(2): u32 klen, key, i32 wait_ms            → u8 status, u64 vlen, val
+//   ADD(3): u32 klen, key, i64 delta              → u8 status, i64 new_value
+// ADD stores the value as a decimal string so SET/GET interoperate.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kSet = 1, kGet = 2, kAdd = 3;
+constexpr int64_t kMaxValue = 1 << 20;  // 1 MiB cap on stored values
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        ::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() {
+    {
+      std::lock_guard<std::mutex> l(m_);
+      stop_ = true;
+      cv_.notify_all();
+      if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // handlers are detached; wait for the last one to finish touching
+    // member state before tearing it down
+    {
+      std::unique_lock<std::mutex> l(m_);
+      cv_.wait(l, [this] { return active_handlers_ == 0; });
+    }
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+ private:
+  void AcceptLoop() {
+    for (;;) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      std::lock_guard<std::mutex> l(m_);
+      if (stop_) {
+        if (fd >= 0) ::close(fd);
+        return;
+      }
+      if (fd < 0) continue;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      client_fds_.insert(fd);
+      ++active_handlers_;
+      // detached so short-lived connections don't accumulate joinable
+      // zombies on a long-lived server; ~StoreServer waits on the count
+      std::thread([this, fd] { Handle(fd); }).detach();
+    }
+  }
+
+  void Handle(int fd) {
+    for (;;) {
+      uint8_t op;
+      if (!ReadFull(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!ReadFull(fd, &klen, 4) || klen > (1u << 16)) break;
+      std::string key(klen, '\0');
+      if (!ReadFull(fd, key.data(), klen)) break;
+      if (op == kSet) {
+        uint64_t vlen;
+        if (!ReadFull(fd, &vlen, 8) || vlen > kMaxValue) break;
+        std::string val(vlen, '\0');
+        if (!ReadFull(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> l(m_);
+          data_[key] = std::move(val);
+          cv_.notify_all();
+        }
+        uint8_t status = 0;
+        if (!WriteFull(fd, &status, 1)) break;
+      } else if (op == kGet) {
+        int32_t wait_ms;
+        if (!ReadFull(fd, &wait_ms, 4)) break;
+        std::string val;
+        uint8_t status = Get(key, wait_ms, &val);
+        uint64_t vlen = val.size();
+        if (!WriteFull(fd, &status, 1) || !WriteFull(fd, &vlen, 8) ||
+            !WriteFull(fd, val.data(), vlen))
+          break;
+      } else if (op == kAdd) {
+        int64_t delta;
+        if (!ReadFull(fd, &delta, 8)) break;
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> l(m_);
+          int64_t cur = 0;
+          auto it = data_.find(key);
+          if (it != data_.end()) cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          now = cur + delta;
+          data_[key] = std::to_string(now);
+          cv_.notify_all();
+        }
+        uint8_t status = 0;
+        if (!WriteFull(fd, &status, 1) || !WriteFull(fd, &now, 8)) break;
+      } else {
+        break;
+      }
+    }
+    std::lock_guard<std::mutex> l(m_);
+    client_fds_.erase(fd);
+    ::close(fd);
+    --active_handlers_;
+    cv_.notify_all();
+  }
+
+  uint8_t Get(const std::string& key, int32_t wait_ms, std::string* out) {
+    std::unique_lock<std::mutex> l(m_);
+    auto found = [&] { return data_.count(key) > 0; };
+    if (!found() && wait_ms != 0) {
+      if (wait_ms < 0) {
+        cv_.wait(l, [&] { return stop_ || found(); });
+      } else {
+        cv_.wait_for(l, std::chrono::milliseconds(wait_ms),
+                     [&] { return stop_ || found(); });
+      }
+    }
+    if (!found()) return 1;  // not found / timeout
+    *out = data_[key];
+    return 0;
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread accept_thread_;
+  int active_handlers_ = 0;  // guarded by m_
+  std::set<int> client_fds_;
+  std::map<std::string, std::string> data_;
+  std::mutex m_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+class StoreClient {
+ public:
+  StoreClient(const char* host, int port, int timeout_ms) {
+    // retry-connect until the deadline: ranks may dial before rank 0's
+    // server is up (same behavior as c10d TCPStore clients)
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1);
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (::getaddrinfo(host, std::to_string(port).c_str(), &hints, &res) != 0)
+      return;
+    do {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd >= 0) {
+        if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          fd_ = fd;
+          break;
+        }
+        ::close(fd);
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    } while (std::chrono::steady_clock::now() < deadline);
+    ::freeaddrinfo(res);
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  bool Set(const std::string& key, const void* val, int64_t vlen) {
+    std::lock_guard<std::mutex> l(m_);
+    uint8_t op = kSet;
+    uint32_t klen = key.size();
+    uint64_t v = static_cast<uint64_t>(vlen);
+    if (!WriteFull(fd_, &op, 1) || !WriteFull(fd_, &klen, 4) ||
+        !WriteFull(fd_, key.data(), klen) || !WriteFull(fd_, &v, 8) ||
+        !WriteFull(fd_, val, vlen))
+      return false;
+    uint8_t status;
+    return ReadFull(fd_, &status, 1) && status == 0;
+  }
+
+  // returns value length (copied into buf up to buflen), -1 not-found/timeout,
+  // -2 transport error, -3 value larger than buf
+  int64_t Get(const std::string& key, void* buf, int64_t buflen, int wait_ms) {
+    std::lock_guard<std::mutex> l(m_);
+    uint8_t op = kGet;
+    uint32_t klen = key.size();
+    int32_t w = wait_ms;
+    if (!WriteFull(fd_, &op, 1) || !WriteFull(fd_, &klen, 4) ||
+        !WriteFull(fd_, key.data(), klen) || !WriteFull(fd_, &w, 4))
+      return -2;
+    uint8_t status;
+    uint64_t vlen;
+    if (!ReadFull(fd_, &status, 1) || !ReadFull(fd_, &vlen, 8)) return -2;
+    std::string val(vlen, '\0');
+    if (vlen > 0 && !ReadFull(fd_, val.data(), vlen)) return -2;
+    if (status != 0) return -1;
+    if (static_cast<int64_t>(vlen) > buflen) return -3;
+    std::memcpy(buf, val.data(), vlen);
+    return static_cast<int64_t>(vlen);
+  }
+
+  int64_t Add(const std::string& key, int64_t delta) {
+    std::lock_guard<std::mutex> l(m_);
+    uint8_t op = kAdd;
+    uint32_t klen = key.size();
+    if (!WriteFull(fd_, &op, 1) || !WriteFull(fd_, &klen, 4) ||
+        !WriteFull(fd_, key.data(), klen) || !WriteFull(fd_, &delta, 8))
+      return INT64_MIN;
+    uint8_t status;
+    int64_t now;
+    if (!ReadFull(fd_, &status, 1) || !ReadFull(fd_, &now, 8) || status != 0)
+      return INT64_MIN;
+    return now;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex m_;  // one outstanding request per client connection
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tpd_store_server_create(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tpd_store_server_port(void* s) {
+  return static_cast<StoreServer*>(s)->port();
+}
+
+void tpd_store_server_destroy(void* s) { delete static_cast<StoreServer*>(s); }
+
+void* tpd_client_create(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient(host, port, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tpd_client_destroy(void* c) { delete static_cast<StoreClient*>(c); }
+
+int tpd_client_set(void* c, const char* key, const void* val, int64_t vlen) {
+  return static_cast<StoreClient*>(c)->Set(key, val, vlen) ? 0 : -1;
+}
+
+int64_t tpd_client_get(void* c, const char* key, void* buf, int64_t buflen,
+                       int wait_ms) {
+  return static_cast<StoreClient*>(c)->Get(key, buf, buflen, wait_ms);
+}
+
+int64_t tpd_client_add(void* c, const char* key, int64_t delta) {
+  return static_cast<StoreClient*>(c)->Add(key, delta);
+}
+
+}  // extern "C"

@@ -43,6 +43,7 @@ class DataLoader:
         sampler: DistributedSampler | None = None,
         transform: Callable[[dict], dict] | None = None,
         drop_remainder: bool = True,
+        native: bool = True,
     ):
         sizes = {k: len(v) for k, v in dataset.items()}
         if len(set(sizes.values())) != 1:
@@ -55,6 +56,11 @@ class DataLoader:
         )
         self.transform = transform
         self.drop_remainder = drop_remainder
+        # native=True routes batch assembly through the C++ core (parallel
+        # gather fused with the ToTensor conversion, tpudist/csrc/batcher.cpp)
+        # when the library is available and the transform supports it; the
+        # numpy path below is the always-available fallback
+        self.native = native
 
     def __len__(self) -> int:
         n = self.sampler.num_samples
@@ -65,6 +71,13 @@ class DataLoader:
         limit = len(self) * self.batch_size if self.drop_remainder else len(indices)
         for start in range(0, limit, self.batch_size):
             idx = indices[start : start + self.batch_size]
+            if self.native:
+                from tpudist.data.native import native_batch
+
+                batch = native_batch(self.dataset, idx, self.transform)
+                if batch is not None:
+                    yield batch
+                    continue
             batch = {k: v[idx] for k, v in self.dataset.items()}
             if self.transform is not None:
                 batch = self.transform(batch)

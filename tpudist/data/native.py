@@ -1,0 +1,152 @@
+"""ctypes wrapper over the C++ batch-assembly core (tpudist/csrc/batcher.cpp).
+
+This is the native half of the DataLoader — the TPU-native counterpart of
+torch's C++ DataLoader machinery (worker pool + pinned staging,
+/root/reference/main.py:54-63, SURVEY.md §2.7). The hot operation is
+gathering the sampler's index shard into one contiguous batch, fused with
+the ToTensor uint8→float32 scale (/root/reference/main.py:46); both run on
+a persistent C++ thread pool. Falls back to numpy transparently when the
+native library is unavailable (see :mod:`tpudist.csrc`).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+
+import numpy as np
+
+from tpudist import csrc
+
+
+def _require_contiguous(src: np.ndarray) -> None:
+    if not src.flags["C_CONTIGUOUS"]:
+        raise ValueError(
+            "native gather requires a C-contiguous source array; "
+            "np.ascontiguousarray it once up front"
+        )
+
+
+def _checked_indices(idx: np.ndarray, n: int) -> np.ndarray:
+    """Validate + normalize indices to int64 with numpy semantics (negative
+    indices wrap; out-of-range raises) — the C side trusts its pointers."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    lo, hi = (int(idx.min()), int(idx.max())) if len(idx) else (0, -1)
+    if lo < -n or hi >= n:
+        raise IndexError(f"index out of range for axis of size {n} "
+                         f"(min {lo}, max {hi})")
+    if lo < 0:
+        idx = np.where(idx < 0, idx + n, idx)
+    return idx
+
+
+class NativeBatcher:
+    """A persistent C++ thread pool with parallel gather kernels."""
+
+    def __init__(self, num_threads: int = 0):
+        lib = csrc.lib()
+        if lib is None:
+            raise RuntimeError("tpudist native core unavailable")
+        self._lib = lib
+        self._pool = lib.tpd_pool_create(num_threads)
+        if not self._pool:
+            raise RuntimeError("tpd_pool_create failed")
+
+    @property
+    def num_threads(self) -> int:
+        return self._lib.tpd_pool_size(self._pool)
+
+    def close(self) -> None:
+        if self._pool:
+            self._lib.tpd_pool_destroy(self._pool)
+            self._pool = None
+
+    def gather(self, src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        """out[i] = src[idx[i]] — dtype-preserving parallel row gather.
+
+        ``src`` must be C-contiguous (the caller owns that invariant; a
+        silent per-batch full copy here would defeat the fast path).
+        """
+        _require_contiguous(src)
+        idx = _checked_indices(idx, len(src))
+        out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+        item_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+        self._lib.tpd_gather_rows(
+            self._pool,
+            src.ctypes.data, item_bytes,
+            idx.ctypes.data, len(idx),
+            out.ctypes.data,
+        )
+        return out
+
+    def gather_u8_to_f32(
+        self, src: np.ndarray, idx: np.ndarray,
+        scale: float = 1.0 / 255.0, shift: float = 0.0,
+    ) -> np.ndarray:
+        """out[i] = float32(src[idx[i]]) * scale + shift, in one fused pass —
+        the sampler gather + ToTensor conversion with no uint8 intermediate."""
+        if src.dtype != np.uint8:
+            raise TypeError(f"expected uint8 source, got {src.dtype}")
+        _require_contiguous(src)
+        idx = _checked_indices(idx, len(src))
+        out = np.empty((len(idx),) + src.shape[1:], np.float32)
+        item_elems = int(np.prod(src.shape[1:], dtype=np.int64))
+        self._lib.tpd_gather_u8_to_f32(
+            self._pool,
+            src.ctypes.data, item_elems,
+            idx.ctypes.data, len(idx),
+            out.ctypes.data,
+            scale, shift,
+        )
+        return out
+
+
+_default: NativeBatcher | None = None
+_default_lock = threading.Lock()
+_default_failed = False
+
+
+def default_batcher() -> NativeBatcher | None:
+    """Process-wide shared batcher (or None when native is unavailable)."""
+    global _default, _default_failed
+    if _default is not None or _default_failed:
+        return _default
+    with _default_lock:
+        if _default is not None or _default_failed:
+            return _default
+        try:
+            _default = NativeBatcher()
+            atexit.register(_default.close)
+        except Exception:
+            _default_failed = True
+    return _default
+
+
+def native_batch(dataset, idx: np.ndarray, transform) -> dict | None:
+    """Assemble a batch through the native core, or None if it can't.
+
+    ``transform`` participates when it advertises a ``native_spec``
+    (mapping key → (scale, shift) for fused uint8→f32 conversion, e.g.
+    :func:`tpudist.data.cifar.to_tensor`); transforms without a spec force
+    the Python path so arbitrary augmentation keeps working.
+    """
+    b = default_batcher()
+    if b is None:
+        return None
+    spec = getattr(transform, "native_spec", None) if transform is not None else {}
+    if spec is None:
+        return None
+    # the fused path only covers uint8 sources and contiguous arrays; any
+    # mismatch falls back to the Python path (which applies the transform)
+    # rather than silently skipping the conversion
+    for k, v in dataset.items():
+        if (k in spec and v.dtype != np.uint8) or not v.flags["C_CONTIGUOUS"]:
+            return None
+    out = {}
+    for k, v in dataset.items():
+        if k in spec:
+            scale, shift = spec[k]
+            out[k] = b.gather_u8_to_f32(v, idx, scale, shift)
+        else:
+            out[k] = b.gather(v, idx)
+    return out

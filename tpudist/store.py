@@ -1,0 +1,176 @@
+"""Host-side rendezvous store — the c10d TCPStore equivalent.
+
+The reference's ``init_method='env://'`` (/root/reference/main.py:34) works
+by rank 0 hosting a C++ TCP key-value store at ``MASTER_ADDR:MASTER_PORT``
+where all ranks meet (SURVEY.md §2.3). jax.distributed brings up the
+*device* world; this store (C++ core: tpudist/csrc/tcpstore.cpp) provides
+the host-side coordination that must work before/without JAX — launcher
+bring-up checks, the rank-0 dataset-download guard (§5 race fix), and
+generic cross-process barriers.
+
+Falls back to a pure-Python in-process store when the native library cannot
+be built (single-process runs never need the TCP path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tpudist import csrc
+
+# must match kMaxValue in tpudist/csrc/tcpstore.cpp
+MAX_VALUE_BYTES = 1 << 20
+
+
+class TCPStore:
+    """Key-value store client; rank 0 (``is_server=True``) also hosts it.
+
+    >>> store = TCPStore("127.0.0.1", 29501, world_size=2, rank=0)   # server
+    >>> store.set("k", b"v"); store.get("k")                          # b'v'
+    >>> store.add("counter", 1)                                       # 1
+    >>> store.barrier("epoch0")          # blocks until all ranks arrive
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        world_size: int = 1,
+        rank: int = 0,
+        is_server: Optional[bool] = None,
+        timeout_ms: int = 60_000,
+    ):
+        lib = csrc.lib()
+        if lib is None:
+            raise RuntimeError(
+                "native TCP store unavailable (no C++ toolchain); "
+                "single-process runs can use tpudist.distributed.barrier"
+            )
+        self._lib = lib
+        self.world_size = world_size
+        self.rank = rank
+        self.timeout_ms = timeout_ms
+        self._server = None
+        self._barrier_uses: dict[str, int] = {}
+        if is_server is None:
+            is_server = rank == 0
+        if is_server:
+            self._server = lib.tpd_store_server_create(port)
+            if not self._server:
+                raise OSError(f"cannot bind TCP store on port {port}")
+            port = lib.tpd_store_server_port(self._server)
+        self.port = port
+        self._client = lib.tpd_client_create(
+            host.encode(), port, timeout_ms
+        )
+        if not self._client:
+            if self._server:
+                lib.tpd_store_server_destroy(self._server)
+                self._server = None
+            raise ConnectionError(f"cannot reach TCP store at {host}:{port}")
+
+    # -- core ops ---------------------------------------------------------
+    def set(self, key: str, value: bytes | str) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if len(value) > MAX_VALUE_BYTES:
+            # the server rejects oversized values by dropping the connection
+            # (protocol-violation defense); refuse client-side instead
+            raise ValueError(
+                f"store value for {key!r} is {len(value)} bytes; "
+                f"max is {MAX_VALUE_BYTES}"
+            )
+        rc = self._lib.tpd_client_set(self._client, key.encode(), value, len(value))
+        if rc != 0:
+            raise ConnectionError(f"store set({key!r}) failed")
+
+    def get(self, key: str, wait: bool = True,
+            timeout_ms: Optional[int] = None) -> bytes | None:
+        """Value for ``key``; blocks until it is set when ``wait`` (None on
+        timeout / missing key when not waiting)."""
+        import ctypes
+
+        wait_ms = (timeout_ms if timeout_ms is not None else self.timeout_ms) if wait else 0
+        buf = ctypes.create_string_buffer(MAX_VALUE_BYTES)
+        n = self._lib.tpd_client_get(
+            self._client, key.encode(), buf, len(buf), wait_ms
+        )
+        if n == -1:
+            return None
+        if n < 0:
+            raise ConnectionError(f"store get({key!r}) failed ({n})")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        """Atomic fetch-add on an integer key; returns the new value."""
+        n = self._lib.tpd_client_add(self._client, key.encode(), delta)
+        if n == -(2**63):
+            raise ConnectionError(f"store add({key!r}) failed")
+        return n
+
+    # -- derived ops ------------------------------------------------------
+    def barrier(self, name: str = "default",
+                timeout_ms: Optional[int] = None) -> None:
+        """Block until all ``world_size`` ranks reach this barrier.
+
+        Reusable: each use of a name is generation-scoped client-side, so
+        ``barrier('epoch')`` in a loop re-synchronizes every iteration (all
+        ranks must call the same barrier sequence, as with any barrier).
+        """
+        if self.world_size <= 1:
+            return
+        gen = self._barrier_uses.get(name, 0)
+        self._barrier_uses[name] = gen + 1
+        key = f"__barrier__/{name}/{gen}"
+        arrived = self.add(f"{key}/count", 1)
+        if arrived == self.world_size:
+            self.set(f"{key}/done", b"1")
+        if self.get(f"{key}/done", timeout_ms=timeout_ms) is None:
+            raise TimeoutError(
+                f"barrier {name!r} (use #{gen}): {arrived}/{self.world_size} "
+                f"ranks arrived before timeout"
+            )
+
+    def broadcast(self, key: str, value: bytes | None = None) -> bytes:
+        """Rank with ``value`` publishes it; everyone returns it."""
+        if value is not None:
+            self.set(key, value)
+            return value
+        out = self.get(key)
+        if out is None:
+            raise TimeoutError(f"broadcast key {key!r} never arrived")
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_client", None):
+            self._lib.tpd_client_destroy(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.tpd_store_server_destroy(self._server)
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def from_env(**kw) -> TCPStore:
+    """Build the store from the launcher's env:// contract — the same
+    variables the reference's launcher exports (SURVEY.md §2.2)."""
+    return TCPStore(
+        os.environ.get("MASTER_ADDR", "127.0.0.1"),
+        int(os.environ.get("MASTER_PORT", "29500")) + 1,  # +1: JAX coordinator owns the base port
+        world_size=int(os.environ.get("WORLD_SIZE", "1")),
+        rank=int(os.environ.get("RANK", "0")),
+        **kw,
+    )
