@@ -49,6 +49,12 @@ def parse_args(argv=None):
     parser.add_argument("--grad_accum", default=1, type=int)
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
+    parser.add_argument("--checkpoint_dir", default=None, type=str,
+                        help="enable async checkpoint/resume (extension; the "
+                        "reference has no persistence, SURVEY.md §5)")
+    parser.add_argument("--checkpoint_every", default=0, type=int,
+                        help="steps between checkpoints (0 = end of run only)")
+    parser.add_argument("--no_resume", action="store_true")
     return parser.parse_args(argv)
 
 
@@ -112,6 +118,9 @@ def main(argv=None):
         grad_accum=args.grad_accum,
         profile=not args.no_profiler,
         log_dir=args.log_dir,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=not args.no_resume,
     )
     return state, losses
 

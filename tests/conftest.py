@@ -22,3 +22,10 @@ import jax  # noqa: E402
 # and rewrites jax_platforms; pin it back to cpu before any backend spins up
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# persistent compilation cache: the suite re-jits the same train steps many
+# times (each fit() in its own test); caching compiled executables across
+# tests and across runs cuts the suite from ~10min to ~2min on CPU
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpudist_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,122 @@
+"""Checkpoint / resume — sharding-aware training-state persistence.
+
+The reference has NO checkpointing (verified in SURVEY.md §5: no
+``state_dict``/``torch.save`` anywhere; training always starts from random
+init, /root/reference/main.py:40, and the process exits without persisting).
+tpudist adds it as a capability extension because on TPU pods it is the
+failure-recovery story (SURVEY.md §5 notes fail-fast is the reference's only
+answer): the launcher restarts a dead world and training resumes from the
+last saved step.
+
+Built on Orbax, the TPU-native checkpoint layer: saves are async (the step
+loop keeps running while the previous checkpoint flushes), every process
+writes only its own shards of sharded arrays (TP/FSDP states don't gather),
+and restore places leaves directly onto the mesh according to a target
+sharding tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from tpudist.train import TrainState
+
+
+@dataclasses.dataclass
+class Checkpointer:
+    """Manages a directory of step-numbered TrainState checkpoints.
+
+    >>> ckpt = Checkpointer("/tmp/run1", max_to_keep=3)
+    >>> ckpt.save(state)                        # async; step from state.step
+    >>> state = ckpt.restore(like=state)        # latest, onto state's shardings
+    >>> ckpt.latest_step()
+    """
+
+    directory: str | Path
+    max_to_keep: int = 3
+
+    def __post_init__(self):
+        self.directory = Path(self.directory).absolute()
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self.max_to_keep,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    # -- write ------------------------------------------------------------
+    def save(self, state: TrainState, step: int | None = None,
+             wait: bool = False) -> bool:
+        """Persist ``state`` (async by default). Returns False if this step
+        is already saved."""
+        if step is None:
+            step = int(state.step)
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+        return saved
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    # -- read -------------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, like: TrainState, step: int | None = None) -> TrainState:
+        """Restore a checkpoint onto the placement of ``like``.
+
+        ``like`` supplies the tree structure, dtypes, and shardings (it can
+        be a freshly-initialized state); leaves are created directly on the
+        devices that own them — no host-side gather.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array) else x,
+            like,
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    # -- run metadata -----------------------------------------------------
+    # guards resume against a changed run geometry (batch size / world size
+    # shift the meaning of state.step, silently corrupting the data order)
+    def write_meta(self, meta: dict) -> None:
+        import json
+
+        if jax.process_index() == 0:
+            (self.directory / "tpudist_meta.json").write_text(json.dumps(meta))
+
+    def read_meta(self) -> dict | None:
+        import json
+
+        p = self.directory / "tpudist_meta.json"
+        return json.loads(p.read_text()) if p.exists() else None
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def latest_step(directory: str | Path) -> int | None:
+    p = Path(directory)
+    if not p.exists():
+        return None
+    with Checkpointer(p) as c:
+        return c.latest_step()

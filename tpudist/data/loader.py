@@ -67,9 +67,15 @@ class DataLoader:
         return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int) -> Iterator[dict]:
+        """Iterate this epoch starting at batch ``start_batch`` — index-level
+        skip for mid-epoch resume (no gather/transform work for the skipped
+        batches, unlike islice over __iter__)."""
         indices = self.sampler.epoch_indices()
         limit = len(self) * self.batch_size if self.drop_remainder else len(indices)
-        for start in range(0, limit, self.batch_size):
+        for start in range(start_batch * self.batch_size, limit, self.batch_size):
             idx = indices[start : start + self.batch_size]
             if self.native:
                 from tpudist.data.native import native_batch

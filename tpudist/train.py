@@ -260,12 +260,24 @@ def fit(
     prefetch_depth: int = 2,
     log_dir: str = ".",
     metrics_logger: MetricsLogger | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = True,
 ) -> tuple[TrainState, list[float]]:
     """The reference's whole training program (/root/reference/main.py:86-117)
     as a function: epochs × batches, per-epoch sampler re-shuffle, windowed
     profiler, TSV metrics, TrainTime footer. Returns final state and the
     per-step loss history.
+
+    ``checkpoint_dir`` enables periodic async checkpointing (every
+    ``checkpoint_every`` steps plus once at the end); with ``resume`` the
+    latest checkpoint is restored and training continues from the exact
+    step it stopped at (same epoch, same position in the sampler's
+    deterministic order) — a capability the reference lacks entirely
+    (SURVEY.md §5: no save/load; crash = start over).
     """
+    import itertools
+
     from tpudist.data.loader import prefetch_to_mesh
 
     mesh = mesh or mesh_lib.create_mesh()
@@ -292,34 +304,85 @@ def fit(
         state_sharding=state_shardings_of(state),
     )
 
+    steps_per_epoch = len(train_loader)
+    run_meta = {
+        "steps_per_epoch": steps_per_epoch,
+        "batch_size": batch_size,
+        "world_size": world_size,
+        "grad_accum": grad_accum,
+    }
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from tpudist.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+        if resume and ckpt.latest_step() is not None:
+            saved_meta = ckpt.read_meta()
+            if saved_meta is not None and saved_meta != run_meta:
+                raise ValueError(
+                    f"checkpoint at {checkpoint_dir} was written by a run "
+                    f"with different geometry ({saved_meta} != {run_meta}); "
+                    "state.step would map to the wrong data position — "
+                    "resume with the original settings or start a fresh "
+                    "checkpoint_dir"
+                )
+            state = ckpt.restore(like=state)
+            start_step = int(state.step)
+        ckpt.write_meta(run_meta)
+
+    start_epoch = start_step // steps_per_epoch
+    skip_batches = start_step % steps_per_epoch
+
     logger = metrics_logger or MetricsLogger(
         job_id, batch_size, global_rank, world_size, log_dir=log_dir
     )
     losses: list[float] = []
     # logger as context manager: the TrainTime footer is written even if a
     # step raises mid-training
-    with logger, WindowedProfiler(
-        job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}"
-    ) as p:
-        print("Start")
-        global_step = 0
-        logger.start_timer()
-        for e in range(epochs):
-            train_loader.sampler.set_epoch(e)
-            for idx, batch in enumerate(
-                prefetch_to_mesh(
-                    iter(train_loader), mesh,
-                    depth=prefetch_depth, stage_fn=step.stage,
-                )
-            ):
-                start = time.time()
-                global_step += 1
-                state, metrics = step(state, batch)
-                loss_value = float(metrics["loss"])  # syncs the step
-                losses.append(loss_value)
-                logger.log_step(global_step, loss_value, time.time() - start)
-                logger.print_progress(e, idx, loss_value)
-                p.step()
+    try:
+        with logger, WindowedProfiler(
+            job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}"
+        ) as p:
+            print("Start")
+            global_step = start_step
+            logger.start_timer()
+            for e in range(start_epoch, epochs):
+                train_loader.sampler.set_epoch(e)
+                first_idx = skip_batches if e == start_epoch else 0
+                # the sampler order is deterministic per epoch, so starting
+                # at the first unconsumed batch resumes mid-epoch at the
+                # exact position the checkpoint was taken; iter_from skips
+                # at the index level (no discarded gather/transform work),
+                # islice is the fallback for foreign loaders
+                if first_idx and hasattr(train_loader, "iter_from"):
+                    batches = train_loader.iter_from(first_idx)
+                elif first_idx:
+                    batches = itertools.islice(iter(train_loader), first_idx, None)
+                else:
+                    batches = iter(train_loader)
+                for idx, batch in enumerate(
+                    prefetch_to_mesh(
+                        batches, mesh,
+                        depth=prefetch_depth, stage_fn=step.stage,
+                    ),
+                    start=first_idx,
+                ):
+                    start = time.time()
+                    global_step += 1
+                    state, metrics = step(state, batch)
+                    loss_value = float(metrics["loss"])  # syncs the step
+                    losses.append(loss_value)
+                    logger.log_step(global_step, loss_value, time.time() - start)
+                    logger.print_progress(e, idx, loss_value)
+                    p.step()
+                    if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
+                        ckpt.save(state)
+            if ckpt and global_step > start_step:
+                ckpt.save(state)
+    finally:
+        if ckpt:
+            ckpt.close()
     return state, losses
 
 
