@@ -115,8 +115,11 @@ class Checkpointer:
 
 
 def latest_step(directory: str | Path) -> int | None:
+    """Newest saved step under ``directory`` — a pure directory scan, no
+    CheckpointManager lifecycle (Orbax step dirs are bare integers; in-flight
+    tmp dirs carry a suffix and are skipped)."""
     p = Path(directory)
     if not p.exists():
         return None
-    with Checkpointer(p) as c:
-        return c.latest_step()
+    steps = [int(d.name) for d in p.iterdir() if d.is_dir() and d.name.isdigit()]
+    return max(steps, default=None)

@@ -8,5 +8,6 @@ expert) keep the door open for further strategies beyond parity.
 """
 
 from tpudist.parallel.dp import dp_shardings
+from tpudist.parallel.fsdp import fsdp_shardings, shard_state
 
-__all__ = ["dp_shardings"]
+__all__ = ["dp_shardings", "fsdp_shardings", "shard_state"]

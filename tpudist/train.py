@@ -304,7 +304,14 @@ def fit(
         state_sharding=state_shardings_of(state),
     )
 
-    steps_per_epoch = len(train_loader)
+    # sized loaders only matter for resume math; a re-iterable loader without
+    # __len__ still trains as long as checkpointing is off
+    steps_per_epoch = len(train_loader) if hasattr(train_loader, "__len__") else None
+    if checkpoint_dir is not None and steps_per_epoch is None:
+        raise ValueError(
+            "checkpointing needs a sized train_loader (len() maps state.step "
+            "to an epoch/batch position for exact resume)"
+        )
     run_meta = {
         "steps_per_epoch": steps_per_epoch,
         "batch_size": batch_size,
@@ -313,34 +320,47 @@ def fit(
     }
     ckpt = None
     start_step = 0
-    if checkpoint_dir is not None:
-        from tpudist.checkpoint import Checkpointer
-
-        ckpt = Checkpointer(checkpoint_dir)
-        if resume and ckpt.latest_step() is not None:
-            saved_meta = ckpt.read_meta()
-            if saved_meta is not None and saved_meta != run_meta:
-                raise ValueError(
-                    f"checkpoint at {checkpoint_dir} was written by a run "
-                    f"with different geometry ({saved_meta} != {run_meta}); "
-                    "state.step would map to the wrong data position — "
-                    "resume with the original settings or start a fresh "
-                    "checkpoint_dir"
-                )
-            state = ckpt.restore(like=state)
-            start_step = int(state.step)
-        ckpt.write_meta(run_meta)
-
-    start_epoch = start_step // steps_per_epoch
-    skip_batches = start_step % steps_per_epoch
-
-    logger = metrics_logger or MetricsLogger(
-        job_id, batch_size, global_rank, world_size, log_dir=log_dir
-    )
     losses: list[float] = []
-    # logger as context manager: the TrainTime footer is written even if a
-    # step raises mid-training
+    logger = None
     try:
+        if checkpoint_dir is not None:
+            from tpudist.checkpoint import Checkpointer
+
+            # inside try/finally so the manager's async-checkpointing threads
+            # are torn down even when bring-up below raises
+            ckpt = Checkpointer(checkpoint_dir)
+            if ckpt.latest_step() is not None:
+                if not resume:
+                    raise ValueError(
+                        f"checkpoint_dir {checkpoint_dir} already holds "
+                        "checkpoints but resume=False; refusing to mix runs "
+                        "(the old steps + overwritten meta would corrupt a "
+                        "later resume) — use a fresh checkpoint_dir"
+                    )
+                saved_meta = ckpt.read_meta()
+                if saved_meta is not None and saved_meta != run_meta:
+                    raise ValueError(
+                        f"checkpoint at {checkpoint_dir} was written by a run "
+                        f"with different geometry ({saved_meta} != {run_meta}); "
+                        "state.step would map to the wrong data position — "
+                        "resume with the original settings or start a fresh "
+                        "checkpoint_dir"
+                    )
+                state = ckpt.restore(like=state)
+                start_step = int(state.step)
+            ckpt.write_meta(run_meta)
+
+        start_epoch = start_step // steps_per_epoch if steps_per_epoch else 0
+        skip_batches = start_step % steps_per_epoch if steps_per_epoch else 0
+
+        # the logger truncates ("w") its TSV on construction, so it must not
+        # exist until checkpoint bring-up has succeeded — a refused resume
+        # above would otherwise clobber the previous run's metrics
+        logger = metrics_logger or MetricsLogger(
+            job_id, batch_size, global_rank, world_size, log_dir=log_dir
+        )
+        # logger as context manager: the TrainTime footer is written even if a
+        # step raises mid-training
         with logger, WindowedProfiler(
             job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}"
         ) as p:
@@ -348,7 +368,8 @@ def fit(
             global_step = start_step
             logger.start_timer()
             for e in range(start_epoch, epochs):
-                train_loader.sampler.set_epoch(e)
+                if hasattr(train_loader, "sampler"):
+                    train_loader.sampler.set_epoch(e)
                 first_idx = skip_batches if e == start_epoch else 0
                 # the sampler order is deterministic per epoch, so starting
                 # at the first unconsumed batch resumes mid-epoch at the
