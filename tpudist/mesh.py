@@ -23,11 +23,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # that gradient all-reduce rides the largest ring.
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+PIPELINE_AXIS = "pipe"
 TENSOR_AXIS = "tensor"
 SEQUENCE_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
-_AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+_AXIS_ORDER = (
+    DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, TENSOR_AXIS
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,6 +43,7 @@ class MeshConfig:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     expert: int = 1
     seq: int = 1
     tensor: int = 1
@@ -48,6 +52,7 @@ class MeshConfig:
         sizes = {
             DATA_AXIS: self.data,
             FSDP_AXIS: self.fsdp,
+            PIPELINE_AXIS: self.pipe,
             EXPERT_AXIS: self.expert,
             SEQUENCE_AXIS: self.seq,
             TENSOR_AXIS: self.tensor,

@@ -18,24 +18,32 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from tpudist.mesh import TENSOR_AXIS
+from tpudist.mesh import PIPELINE_AXIS, TENSOR_AXIS
 from tpudist.ops.attention import multi_head_attention
-from tpudist.parallel.tp import partitioned
+from tpudist.parallel.pp import pipeline_apply
+from tpudist.parallel.tp import partitioned as _partitioned
 
 
 class Block(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
+    # tp=False drops the tensor-axis partitioning metadata — required when
+    # the block runs inside a shard_map manual-mesh context (the pipelined
+    # model), where flax's eval_shape re-run of boxed initializers would
+    # apply sharding constraints that cannot be resolved
+    tp: bool = True
 
     @nn.compact
     def __call__(self, x):
         b, s, d = x.shape
         h = self.num_heads
         dense_init = nn.initializers.lecun_normal()
+        partitioned = _partitioned if self.tp else (lambda init, *axes: init)
         y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
         # column-parallel: head dim sharded over 'tensor'
         qkv = nn.DenseGeneral(
@@ -79,7 +87,7 @@ class GPT2(nn.Module):
         b, s = tokens.shape
         wte = self.param(
             "wte",
-            partitioned(nn.initializers.normal(0.02), TENSOR_AXIS, None),
+            _partitioned(nn.initializers.normal(0.02), TENSOR_AXIS, None),
             (self.vocab_size, self.hidden_dim), jnp.float32,
         )
         wpe = self.param(
@@ -98,3 +106,93 @@ class GPT2(nn.Module):
 
 def gpt2_124m(**kw) -> GPT2:
     return GPT2(**kw)
+
+
+class PipelinedGPT2:
+    """GPT-2 with its blocks stacked ``[depth, ...]`` and run through GPipe
+    microbatch pipelining over the ``pipe`` mesh axis
+    (``tpudist.parallel.pp``).
+
+    Duck-types the flax ``init``/``apply`` surface that
+    ``tpudist.train.create_train_state``/``make_train_step`` drive, so the
+    ordinary compiled train step works unchanged: ``init`` boxes the stacked
+    block params with ``nn.Partitioned(('pipe', None, ...))`` metadata, which
+    ``create_train_state`` turns into layer-over-stage placement (and
+    matching Adam-moment shardings); ``apply`` embeds, pipelines the blocks,
+    and runs the stage-replicated final LayerNorm + weight-tied head.
+
+    Embedding/head stay outside the pipeline (computed replicated over
+    ``pipe``) — standard for shallow heads; the depth is where the memory is.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        *,
+        num_micro: int,
+        vocab_size: int = 50257,
+        max_seq_len: int = 1024,
+        hidden_dim: int = 768,
+        depth: int = 12,
+        num_heads: int = 12,
+        dtype: Any = jnp.float32,
+        attn_impl: str = "xla",
+    ):
+        if depth % mesh.shape[PIPELINE_AXIS]:
+            raise ValueError(
+                f"depth {depth} not divisible by pipe={mesh.shape[PIPELINE_AXIS]}"
+            )
+        self.mesh = mesh
+        self.num_micro = num_micro
+        self.vocab_size = vocab_size
+        self.max_seq_len = max_seq_len
+        self.hidden_dim = hidden_dim
+        self.depth = depth
+        self.dtype = dtype
+        self.block = Block(num_heads, dtype=dtype, attn_impl=attn_impl, tp=False)
+
+    def init(self, rng, tokens, train: bool = False):
+        r_wte, r_wpe, r_blocks = jax.random.split(rng, 3)
+        d = self.hidden_dim
+        sample = jnp.zeros((1, int(tokens.shape[-1]), d), self.dtype)
+        # per-layer init, unboxed (the Blocks' tensor-axis boxes would be
+        # off-by-one after stacking), then re-boxed layer-dim-over-'pipe'
+        stacked = jax.vmap(
+            lambda r: nn.meta.unbox(self.block.init(r, sample)["params"])
+        )(jax.random.split(r_blocks, self.depth))
+        blocks = jax.tree_util.tree_map(
+            lambda a: nn.Partitioned(
+                a, names=(PIPELINE_AXIS,) + (None,) * (a.ndim - 1)
+            ),
+            stacked,
+        )
+        params = {
+            "wte": nn.initializers.normal(0.02)(
+                r_wte, (self.vocab_size, d), jnp.float32
+            ),
+            "wpe": nn.initializers.normal(0.01)(
+                r_wpe, (self.max_seq_len, d), jnp.float32
+            ),
+            "blocks": blocks,
+            "ln_f": {"scale": jnp.ones((d,), jnp.float32),
+                     "bias": jnp.zeros((d,), jnp.float32)},
+        }
+        return {"params": params}
+
+    def apply(self, variables, tokens, train: bool = True):
+        p = variables["params"]
+        s = tokens.shape[1]
+        x = p["wte"][tokens].astype(self.dtype) + p["wpe"][:s].astype(self.dtype)
+
+        def block_fn(bp, h):
+            return self.block.apply({"params": bp}, h)
+
+        x = pipeline_apply(
+            block_fn, p["blocks"], x, self.mesh, num_micro=self.num_micro
+        )
+        # same module (and epsilon) as plain GPT2's ln_f
+        x = nn.LayerNorm(dtype=self.dtype).apply({"params": p["ln_f"]}, x)
+        return jnp.einsum(
+            "bsd,vd->bsv", x, p["wte"].astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )

@@ -1,0 +1,164 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over the
+``pipe`` mesh axis.
+
+No reference counterpart (SURVEY.md §2.12: the reference's only strategy is
+DDP, /root/reference/main.py:83); built so the framework scales depth past
+one chip. TPU-native design (the "How to Scale Your Model" pipelining
+recipe, not a torch-style stage-process scheduler):
+
+- The model's repeated blocks are *stacked*: every param leaf carries a
+  leading ``[n_layers, ...]`` dimension, sharded ``P('pipe')`` — stage ``i``
+  of the mesh holds layers ``[i·L/S, (i+1)·L/S)`` in its HBM. There is no
+  per-stage process or RPC; the whole pipeline is ONE jitted SPMD program.
+- Inside :func:`pipeline_apply`, a ``shard_map`` over ``pipe`` runs the
+  classic GPipe schedule as a ``lax.scan`` over ``num_micro + n_stages - 1``
+  ticks: each tick every stage applies its local layers to the activation it
+  holds, then ``lax.ppermute`` shifts activations one hop down the ring
+  (stage 0 feeds in the next microbatch, the last stage banks its result).
+  The hop is a neighbor exchange on ICI that XLA overlaps with the next
+  tick's compute.
+- Ramp-up/ramp-down ticks compute on garbage (the pipeline bubble,
+  ``(S-1)/(M+S-1)`` of the schedule) — outputs are gated so garbage never
+  escapes; choose ``num_micro >= 4·n_stages`` to amortize.
+- Everything (``scan``, ``ppermute``, the gating ``where``) is
+  differentiable, so ``jax.grad`` of a loss through :func:`pipeline_apply`
+  yields the full backward pipeline, with XLA scheduling the reverse-order
+  hops.
+
+Composition with the other axes falls out of the mesh: the microbatch dim is
+sharded over ``data``/``fsdp`` (each stage computes on its data shard), and
+stacked block params may additionally carry ``tensor`` annotations on their
+trailing dims for TP-within-stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.mesh import DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS
+
+
+def stacked_param_specs(stacked_params, *, axis: str = PIPELINE_AXIS):
+    """PartitionSpec tree for stacked block params: leading (layer) dim
+    sharded over ``pipe``, trailing dims replicated."""
+    return jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    )
+
+
+def stacked_param_shardings(stacked_params, mesh: Mesh, *, axis: str = PIPELINE_AXIS):
+    """NamedSharding tree placing stacked block params layer-wise over the
+    pipeline stages."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        stacked_param_specs(stacked_params, axis=axis),
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def _pipeline_local(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    params_local,
+    x_local: jax.Array,
+    *,
+    axis_name: str,
+):
+    """Per-stage GPipe schedule — runs inside ``shard_map``.
+
+    ``params_local``: this stage's layer slice, leaves ``[L/S, ...]``.
+    ``x_local``: all microbatches of this device's data shard,
+    ``[num_micro, micro_batch, ...]`` (replicated over ``pipe``).
+    Returns the pipeline output for every microbatch, same shape as
+    ``x_local`` (valid on every stage — the last stage's results are
+    ``psum``-broadcast over the ``pipe`` axis).
+    """
+    n = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    nm = x_local.shape[0]
+    is_first = stage == 0
+    is_last = stage == n - 1
+    perm = [(i, i + 1) for i in range(n - 1)]  # one hop down; stage 0 gets zeros
+
+    def stage_fn(h):
+        def layer(h, p):
+            return block_fn(p, h), None
+
+        h, _ = jax.lax.scan(layer, h, params_local)
+        return h
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t (clamped past the end — garbage ticks
+        # are gated below); later stages consume what the ring delivered
+        mb = jax.lax.dynamic_index_in_dim(
+            x_local, jnp.clip(t, 0, nm - 1), keepdims=False
+        )
+        inp = jnp.where(is_first, mb, buf)
+        y = stage_fn(inp)
+        # the last stage banks microbatch t-(n-1) once it's real
+        out_idx = t - (n - 1)
+        slot = jnp.clip(out_idx, 0, nm - 1)
+        prev = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(is_last & (out_idx >= 0), y, prev), slot, 0
+        )
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros_like(x_local[0])
+    outs0 = jnp.zeros_like(x_local)
+    # zero carries must match the per-shard compute's varying-manual-axes
+    # type or scan rejects the carry signature (same trick as parallel/cp.py):
+    # y varies over 'pipe' (axis_index feeds the gating), the zeros don't yet
+    if hasattr(jax.typeof(x_local), "vma"):
+        buf0, outs0 = (
+            jax.lax.pcast(x, (axis_name,), to="varying") for x in (buf0, outs0)
+        )
+    (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(nm + n - 1))
+    # only the last stage holds real outputs; psum broadcasts them so the
+    # loss/head can run stage-replicated (zeros elsewhere contribute nothing)
+    return jax.lax.psum(outs, axis_name)
+
+
+def pipeline_apply(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    num_micro: int,
+    axis: str = PIPELINE_AXIS,
+    batch_axes=(DATA_AXIS, FSDP_AXIS),
+):
+    """Run ``x`` through the stacked blocks with GPipe pipelining.
+
+    ``block_fn(layer_params, h) -> h`` applies ONE block (same input/output
+    shape — residual blocks). ``stacked_params``: leaves ``[n_layers, ...]``;
+    ``n_layers`` must divide by the mesh's ``pipe`` size. ``x``:
+    ``[batch, ...]`` with ``batch`` divisible by ``num_micro`` (and the
+    microbatch by the ``data`` sharding).
+    """
+    n_stages = mesh.shape[axis]
+    layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if layers % n_stages:
+        raise ValueError(f"{layers} layers not divisible by {n_stages} stages")
+    b = x.shape[0]
+    if b % num_micro:
+        raise ValueError(f"batch {b} not divisible by num_micro {num_micro}")
+    xm = x.reshape(num_micro, b // num_micro, *x.shape[1:])
+
+    x_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+    fn = shard_map(
+        functools.partial(_pipeline_local, block_fn, axis_name=axis),
+        mesh=mesh,
+        in_specs=(stacked_param_specs(stacked_params, axis=axis), x_spec),
+        out_specs=x_spec,
+    )
+    out = fn(stacked_params, xm)
+    return out.reshape(b, *out.shape[2:])
