@@ -1,0 +1,156 @@
+"""Mixture-of-experts / expert parallelism (tpudist.parallel.ep).
+
+The reference has no MoE (SURVEY.md §2.12) — these tests pin down the
+routing math and the expert-sharded execution path the same way
+test_dp_equivalence pins down DP: sharded ≡ unsharded, dispatch ≡ a
+per-token reference computation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpudist import mesh as mesh_lib
+from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch
+
+
+def test_expert_capacity():
+    # ceil(2*64/8)=16, ×1.25 → 20
+    assert expert_capacity(64, 8, top_k=2, capacity_factor=1.25) == 20
+    assert expert_capacity(3, 8, top_k=1, capacity_factor=1.0) == 1
+
+
+def test_dispatch_matches_per_token_reference():
+    """With ample capacity, MoE output == Σ_k gate_k · FFN_{e_k}(token)."""
+    rng = np.random.Generator(np.random.PCG64(0))
+    T, E, d = 16, 4, 8
+    probs = jax.nn.softmax(jnp.asarray(rng.normal(size=(T, E)), jnp.float32))
+    capacity = T  # nothing can drop
+    dispatch, combine, _ = top_k_dispatch(probs, 2, capacity)
+
+    # every token assigned to exactly 2 experts, each in exactly one slot
+    np.testing.assert_allclose(np.sum(dispatch, axis=(1, 2)), 2.0, rtol=1e-6)
+    # combine weights renormalize the top-2 gates to 1
+    np.testing.assert_allclose(np.sum(combine, axis=(1, 2)), 1.0, rtol=1e-5)
+
+    # no slot double-booked
+    assert np.max(np.sum(dispatch, axis=0)) <= 1.0 + 1e-6
+
+    # dispatch→expert→combine reproduces per-token top-2 mixture
+    x = jnp.asarray(rng.normal(size=(T, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(E, d, d)), jnp.float32)
+    slots = jnp.einsum("tec,td->ecd", dispatch, x)
+    out = jnp.einsum("ecd,edf->ecf", slots, w)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+
+    top2 = np.argsort(-np.asarray(probs), axis=1)[:, :2]
+    for t in range(T):
+        e0, e1 = top2[t]
+        g0, g1 = float(probs[t, e0]), float(probs[t, e1])
+        g0, g1 = g0 / (g0 + g1), g1 / (g0 + g1)
+        want = g0 * (x[t] @ w[e0]) + g1 * (x[t] @ w[e1])
+        np.testing.assert_allclose(np.asarray(y[t]), np.asarray(want), rtol=2e-5, atol=1e-5)
+
+
+def test_capacity_dropping():
+    """Tokens beyond an expert's capacity contribute zero (not garbage)."""
+    T, E = 8, 2
+    # all tokens want expert 0
+    probs = jnp.tile(jnp.asarray([[0.9, 0.1]], jnp.float32), (T, 1))
+    dispatch, combine, _ = top_k_dispatch(probs, 1, capacity=3)
+    # exactly 3 tokens land (token order), the rest drop
+    assert float(jnp.sum(dispatch)) == 3.0
+    np.testing.assert_allclose(
+        np.sum(np.asarray(dispatch), axis=(1, 2)), [1, 1, 1, 0, 0, 0, 0, 0]
+    )
+    # dropped tokens have zero combine weight → residual passes them through
+    assert float(jnp.sum(combine[3:])) == 0.0
+
+
+def test_aux_loss_balanced_is_one():
+    T, E = 64, 8
+    probs = jnp.full((T, E), 1.0 / E, jnp.float32)
+    # break argmax ties deterministically across experts
+    probs = probs + jax.nn.one_hot(jnp.arange(T) % E, E) * 1e-4
+    _, _, aux = top_k_dispatch(probs, 1, capacity=T)
+    assert abs(float(aux) - 1.0) < 1e-2
+
+
+def test_moe_layer_runs_and_sows_aux():
+    layer = MoEMlp(num_experts=4, top_k=2, capacity_factor=2.0)
+    x = jnp.asarray(
+        np.random.Generator(np.random.PCG64(1)).normal(size=(2, 8, 16)), jnp.float32
+    )
+    variables = layer.init(jax.random.key(0), x)
+    y, updates = layer.apply(variables, x, mutable=["losses"])
+    assert y.shape == x.shape
+    (aux,) = jax.tree_util.tree_leaves(updates["losses"])
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_expert_sharded_equals_unsharded():
+    """The same MoE GPT-2 step on an expert=4 mesh and a 1-device mesh
+    produces the same loss — expert parallelism changes placement, not math."""
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import (
+        create_train_state, lm_loss, make_train_step, state_shardings_of,
+    )
+
+    rng = np.random.Generator(np.random.PCG64(2))
+    tokens = {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+
+    losses = {}
+    for name, cfg in {
+        "single": mesh_lib.MeshConfig(data=1),
+        "ep": mesh_lib.MeshConfig(data=2, expert=4),
+    }.items():
+        devices = jax.devices()[: 1 if name == "single" else 8]
+        mesh = mesh_lib.create_mesh(cfg, devices=devices)
+        model = GPT2(
+            vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+            num_heads=2, num_experts=4, moe_every=1, capacity_factor=2.0,
+            mesh=mesh,
+        )
+        tx = optax.adam(1e-3)
+        state = create_train_state(
+            model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", state_sharding=state_shardings_of(state),
+        )
+        state, metrics = step(state, tokens)
+        losses[name] = float(metrics["loss"])
+
+    assert np.isfinite(losses["single"])
+    np.testing.assert_allclose(losses["single"], losses["ep"], rtol=2e-5)
+
+
+def test_moe_gpt2_loss_decreases():
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=2, expert=4))
+    model = GPT2(
+        vocab_size=32, max_seq_len=16, hidden_dim=32, depth=2, num_heads=2,
+        num_experts=4, capacity_factor=2.0, mesh=mesh,
+    )
+    tx = optax.adam(1e-2)
+    state = create_train_state(model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh)
+    from tpudist.train import state_shardings_of
+
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    rng = np.random.Generator(np.random.PCG64(3))
+    batch = {"tokens": rng.integers(0, 32, (8, 16)).astype(np.int32)}
+    first = None
+    for _ in range(20):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first, f"loss did not decrease: {first} -> {last}"

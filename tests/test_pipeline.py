@@ -91,7 +91,7 @@ def test_pipeline_params_actually_sharded():
 def test_pipelined_gpt2_train_step():
     """Full compiled train step on PipelinedGPT2 over a data×pipe mesh:
     pipe-sharded stacked blocks + Adam moments, loss finite and decreasing."""
-    from tpudist.models.gpt2 import GPT2, PipelinedGPT2
+    from tpudist.models.gpt2 import PipelinedGPT2
     from tpudist.train import (
         create_train_state, lm_loss, make_train_step, state_shardings_of,
     )

@@ -37,6 +37,12 @@ class Block(nn.Module):
     # model), where flax's eval_shape re-run of boxed initializers would
     # apply sharding constraints that cannot be resolved
     tp: bool = True
+    # num_experts > 0 swaps the dense MLP for a mixture-of-experts FFN
+    # (tpudist.parallel.ep) routed top-k with expert-sharded weights
+    num_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -60,16 +66,25 @@ class Block(nn.Module):
         )(attn)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
-        y = nn.Dense(
-            4 * d, dtype=self.dtype, name="mlp_fc",
-            kernel_init=partitioned(dense_init, None, TENSOR_AXIS),
-            bias_init=partitioned(nn.initializers.zeros_init(), TENSOR_AXIS),
-        )(y)
-        y = nn.gelu(y)
-        y = nn.Dense(
-            d, dtype=self.dtype, name="mlp_proj",
-            kernel_init=partitioned(dense_init, TENSOR_AXIS, None),
-        )(y)
+        if self.num_experts > 0:
+            from tpudist.parallel.ep import MoEMlp
+
+            y = MoEMlp(
+                num_experts=self.num_experts, top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor, dtype=self.dtype,
+                mesh=self.mesh, name="moe",
+            )(y)
+        else:
+            y = nn.Dense(
+                4 * d, dtype=self.dtype, name="mlp_fc",
+                kernel_init=partitioned(dense_init, None, TENSOR_AXIS),
+                bias_init=partitioned(nn.initializers.zeros_init(), TENSOR_AXIS),
+            )(y)
+            y = nn.gelu(y)
+            y = nn.Dense(
+                d, dtype=self.dtype, name="mlp_proj",
+                kernel_init=partitioned(dense_init, TENSOR_AXIS, None),
+            )(y)
         return x + y
 
 
@@ -81,6 +96,18 @@ class GPT2(nn.Module):
     num_heads: int = 12
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
+    # num_experts > 0 makes every ``moe_every``-th block an MoE block
+    # (tpudist.parallel.ep); aux load-balance losses are sowed into the
+    # ``losses`` collection, which tpudist.train adds to the task loss
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    mesh: Any = None
+
+    @property
+    def has_aux_loss(self) -> bool:
+        return self.num_experts > 0
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -95,7 +122,13 @@ class GPT2(nn.Module):
         )
         x = wte[tokens].astype(self.dtype) + wpe[:s].astype(self.dtype)
         for i in range(self.depth):
-            x = Block(self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl, name=f"h_{i}")(x)
+            moe_here = self.num_experts > 0 and (i % self.moe_every == self.moe_every - 1)
+            x = Block(
+                self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
+                num_experts=self.num_experts if moe_here else 0,
+                moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
+                mesh=self.mesh, name=f"h_{i}",
+            )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         # weight-tied LM head
         logits = jnp.einsum(

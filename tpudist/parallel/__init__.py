@@ -8,10 +8,12 @@ expert) keep the door open for further strategies beyond parity.
 """
 
 from tpudist.parallel.dp import dp_shardings
+from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch
 from tpudist.parallel.fsdp import fsdp_shardings, shard_state
 from tpudist.parallel.pp import pipeline_apply, stacked_param_shardings
 
 __all__ = [
     "dp_shardings", "fsdp_shardings", "shard_state",
     "pipeline_apply", "stacked_param_shardings",
+    "MoEMlp", "expert_capacity", "top_k_dispatch",
 ]

@@ -1,0 +1,176 @@
+"""Expert parallelism — Mixture-of-Experts with GShard-style einsum dispatch
+over the ``expert`` mesh axis.
+
+No reference counterpart (SURVEY.md §2.12: the reference's only strategy is
+DDP, /root/reference/main.py:83); built so the framework scales parameter
+count past dense models. TPU-native design:
+
+- **Static shapes everywhere.** Routing is expressed as dense one-hot
+  dispatch/combine tensors (the GShard/Switch formulation), not gather/
+  scatter with data-dependent sizes: each expert has a fixed ``capacity``
+  slot count and tokens beyond capacity are dropped (their contribution is
+  zero; transformer residuals carry them through unchanged). XLA sees only
+  einsums — all of it tiles onto the MXU.
+- **Expert placement = sharding metadata.** Stacked expert FFN weights
+  ``[E, d, ff]`` carry ``nn.with_partitioning(..., ('expert', ...))``; the
+  dispatched activations ``[E, capacity, d]`` are sharding-constrained to
+  ``P('expert')`` on the expert dim. From those two constraints GSPMD derives
+  the token all-to-all (data-sharded tokens → expert-sharded slots and back)
+  and schedules it on ICI — there is no hand-written collective, mirroring
+  how tpudist's DP lets XLA derive the gradient all-reduce (SURVEY.md §2.5).
+- **Load balance is a differentiable aux loss** (Switch-style
+  ``E · Σ_e f_e·P_e``), sowed into the ``losses`` collection; the train step
+  (tpudist.train) adds any sowed losses to the task loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.mesh import EXPERT_AXIS, TENSOR_AXIS
+
+
+def expert_capacity(
+    num_tokens: int, num_experts: int, *, top_k: int, capacity_factor: float
+) -> int:
+    """Per-expert slot count: ``ceil(top_k · T / E) · capacity_factor``,
+    rounded up — the static buffer size every expert processes."""
+    import math
+
+    base = (top_k * num_tokens + num_experts - 1) // num_experts
+    return max(1, math.ceil(base * capacity_factor))
+
+
+def top_k_dispatch(probs: jax.Array, top_k: int, capacity: int):
+    """Router probabilities → (dispatch, combine, aux_loss).
+
+    ``probs``: ``[T, E]`` softmax router output.
+    ``dispatch``: ``[T, E, C]`` 0/1 — token t occupies slot c of expert e.
+    ``combine``: ``dispatch`` weighted by the token's (renormalized) gate.
+    ``aux_loss``: Switch-style load-balance loss, 1.0 at perfect balance.
+
+    Slot assignment order is token order (cumsum over the token dim), with
+    all k-th choices placed after all (k-1)-th choices — the GShard priority
+    rule, so a token's secondary expert never evicts another's primary.
+    """
+    T, E = probs.shape
+    gates, masks = [], []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [T, E]
+        gates.append(jnp.sum(p * m, axis=-1))  # [T]
+        masks.append(m)
+        p = p * (1.0 - m)
+
+    # aux loss from primary assignments: E · Σ_e (token fraction)·(mean prob)
+    f = jnp.mean(masks[0], axis=0)
+    pr = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(f * pr)
+
+    # renormalize the kept gates so they sum to 1 per token
+    denom = sum(gates) + 1e-9
+    gates = [g / denom for g in gates]
+
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    counts = jnp.zeros((E,), jnp.int32)  # slots consumed by earlier choices
+    for g, m in zip(gates, masks):
+        # positions in int32 — a float cumsum in low-precision dtypes (bf16
+        # tops out at 256) would collide positions and double-book slots
+        mi = m.astype(jnp.int32)
+        pos = jnp.cumsum(mi, axis=0) - mi + counts  # [T, E]
+        pos_t = jnp.sum(pos * mi, axis=-1)  # [T]
+        keep = (pos_t < capacity) & (jnp.sum(mi, axis=-1) > 0)
+        slot = jax.nn.one_hot(pos_t, capacity, dtype=probs.dtype)
+        d = m[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * g[:, None, None]
+        counts = counts + jnp.sum(mi, axis=0)
+    return dispatch, combine, aux_loss
+
+
+class MoEMlp(nn.Module):
+    """Mixture-of-experts FFN (drop-in for a transformer's dense MLP).
+
+    ``x: [batch, seq, d] → [batch, seq, d]``; top-``top_k`` routing into
+    ``num_experts`` gelu FFNs of width ``mlp_ratio·d``; expert weights are
+    expert-sharded (and FFN-dim tensor-sharded) via partitioning metadata.
+    Sows the scaled load-balance loss into the ``losses`` collection.
+    """
+
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    mlp_ratio: int = 4
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.float32
+    mesh: Any = None  # when set, activations get explicit expert shardings
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        E = self.num_experts
+        ff = self.mlp_ratio * d
+        T = b * s
+        tokens = x.reshape(T, d)
+
+        # router in fp32 — cheap, and argmax ties/probs stay stable in bf16 runs
+        wr = self.param(
+            "router", nn.initializers.lecun_normal(), (d, E), jnp.float32
+        )
+        probs = jax.nn.softmax(tokens.astype(jnp.float32) @ wr)
+        capacity = expert_capacity(
+            T, E, top_k=self.top_k, capacity_factor=self.capacity_factor
+        )
+        dispatch, combine, aux = top_k_dispatch(probs, self.top_k, capacity)
+        self.sow(
+            "losses", "moe_aux_loss", self.aux_loss_weight * aux,
+            reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+
+        w1 = self.param(
+            "w1",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), (EXPERT_AXIS, None, TENSOR_AXIS)
+            ),
+            (E, d, ff), jnp.float32,
+        )
+        w2 = self.param(
+            "w2",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), (EXPERT_AXIS, TENSOR_AXIS, None)
+            ),
+            (E, ff, d), jnp.float32,
+        )
+
+        # tokens (data-sharded) → expert slots: GSPMD turns the sharding jump
+        # into the all-to-all
+        slots = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+        )
+        slots = self._constrain(slots)
+        h = jnp.einsum("ecd,edf->ecf", slots, w1.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
+        out = self._constrain(out)
+        # expert slots → tokens (the reverse all-to-all), gate-weighted
+        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), out)
+        return y.reshape(b, s, d)
+
+    def _constrain(self, slots):
+        if self.mesh is None:
+            return slots
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            slots, NamedSharding(self.mesh, P(EXPERT_AXIS, None, None))
+        )
+
+
+def expert_parallel_size(mesh) -> int:
+    return mesh.shape[EXPERT_AXIS]

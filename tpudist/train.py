@@ -150,19 +150,29 @@ def make_train_step(
     """
     batch_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
+    # models that sow auxiliary losses (e.g. MoE load-balance,
+    # parallel/ep.py) declare it via ``has_aux_loss``; duck-typed models
+    # without the attribute keep the plain (non-mutable) apply path
+    wants_aux = bool(getattr(model, "has_aux_loss", False))
+
     def forward(params, batch_stats, batch):
         variables = {"params": params, "batch_stats": batch_stats}
         has_stats = len(batch_stats) > 0
         inputs = batch[input_key]
-        if has_stats:
+        mutable = (["batch_stats"] if has_stats else []) + (
+            ["losses"] if wants_aux else []
+        )
+        if mutable:
             logits, updates = model.apply(
-                variables, inputs, train=True, mutable=["batch_stats"]
+                variables, inputs, train=True, mutable=mutable
             )
-            new_stats = updates["batch_stats"]
+            new_stats = updates.get("batch_stats", batch_stats)
+            aux = sum(jax.tree_util.tree_leaves(updates.get("losses", {})), 0.0)
         else:
             logits = model.apply(variables, inputs, train=True)
             new_stats = batch_stats
-        loss = loss_fn(logits, batch[label_key])
+            aux = 0.0
+        loss = loss_fn(logits, batch[label_key]) + aux
         return loss, new_stats
 
     if remat:
