@@ -58,7 +58,16 @@ class Block(nn.Module):
             bias_init=partitioned(nn.initializers.zeros_init(), None, TENSOR_AXIS, None),
         )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
+        if self.attn_impl in ("ring", "ulysses"):
+            # context-parallel attention over the 'seq' mesh axis
+            # (tpudist.parallel.cp); activations arrive sequence-sharded and
+            # the shard_map keeps them that way — requires ``mesh``
+            from tpudist.parallel.cp import ring_attention, ulysses_attention
+
+            cp_fn = ring_attention if self.attn_impl == "ring" else ulysses_attention
+            attn = cp_fn(q, k, v, self.mesh, causal=True)
+        else:
+            attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
         # row-parallel: contraction dim sharded; GSPMD all-reduces the output
         y = nn.DenseGeneral(
             d, axis=(-2, -1), dtype=self.dtype, name="out",

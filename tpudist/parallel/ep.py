@@ -72,9 +72,12 @@ def top_k_dispatch(probs: jax.Array, top_k: int, capacity: int):
     pr = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(f * pr)
 
-    # renormalize the kept gates so they sum to 1 per token
-    denom = sum(gates) + 1e-9
-    gates = [g / denom for g in gates]
+    # top-1 (Switch) keeps the raw gate — renormalizing a single gate to ~1
+    # would zero the router's task-loss gradient; top-k≥2 renormalizes the
+    # kept gates to sum to 1 (GShard)
+    if top_k > 1:
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
 
     dispatch = jnp.zeros((T, E, capacity), probs.dtype)
     combine = jnp.zeros((T, E, capacity), probs.dtype)
@@ -101,6 +104,13 @@ class MoEMlp(nn.Module):
     ``num_experts`` gelu FFNs of width ``mlp_ratio·d``; expert weights are
     expert-sharded (and FFN-dim tensor-sharded) via partitioning metadata.
     Sows the scaled load-balance loss into the ``losses`` collection.
+
+    Routing is **grouped** (GShard): tokens are split into ``num_groups``
+    independent dispatch groups (default: one per batch row, so groups ride
+    the existing ``data`` sharding) and capacity is per-group. This keeps the
+    dispatch/combine one-hots at O(group_size²·E⁻¹) instead of O(T²·E⁻¹) —
+    ungrouped routing over batch·seq tokens would put multi-hundred-MB
+    mostly-zero tensors in HBM at realistic LM shapes.
     """
 
     num_experts: int
@@ -108,6 +118,7 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.25
     mlp_ratio: int = 4
     aux_loss_weight: float = 0.01
+    num_groups: int = 0  # 0 → one group per batch row
     dtype: Any = jnp.float32
     mesh: Any = None  # when set, activations get explicit expert shardings
 
@@ -116,20 +127,28 @@ class MoEMlp(nn.Module):
         b, s, d = x.shape
         E = self.num_experts
         ff = self.mlp_ratio * d
+        G = self.num_groups or b
         T = b * s
-        tokens = x.reshape(T, d)
+        if T % G:
+            raise ValueError(f"{T} tokens not divisible into {G} groups")
+        t = T // G
+        tokens = x.reshape(G, t, d)
 
         # router in fp32 — cheap, and argmax ties/probs stay stable in bf16 runs
         wr = self.param(
             "router", nn.initializers.lecun_normal(), (d, E), jnp.float32
         )
-        probs = jax.nn.softmax(tokens.astype(jnp.float32) @ wr)
-        capacity = expert_capacity(
-            T, E, top_k=self.top_k, capacity_factor=self.capacity_factor
+        probs = jax.nn.softmax(
+            jnp.einsum("gtd,de->gte", tokens.astype(jnp.float32), wr)
         )
-        dispatch, combine, aux = top_k_dispatch(probs, self.top_k, capacity)
+        capacity = expert_capacity(
+            t, E, top_k=self.top_k, capacity_factor=self.capacity_factor
+        )
+        dispatch, combine, aux = jax.vmap(
+            lambda p: top_k_dispatch(p, self.top_k, capacity)
+        )(probs)
         self.sow(
-            "losses", "moe_aux_loss", self.aux_loss_weight * aux,
+            "losses", "moe_aux_loss", self.aux_loss_weight * jnp.mean(aux),
             reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.zeros((), jnp.float32),
         )
 
@@ -148,29 +167,31 @@ class MoEMlp(nn.Module):
             (E, ff, d), jnp.float32,
         )
 
-        # tokens (data-sharded) → expert slots: GSPMD turns the sharding jump
-        # into the all-to-all
+        # tokens (data-sharded groups) → expert slots: GSPMD turns the
+        # sharding jump into the all-to-all
         slots = jnp.einsum(
-            "tec,td->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+            "gtec,gtd->gecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
         )
         slots = self._constrain(slots)
-        h = jnp.einsum("ecd,edf->ecf", slots, w1.astype(self.dtype))
+        h = jnp.einsum("gecd,edf->gecf", slots, w1.astype(self.dtype))
         h = nn.gelu(h)
-        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
+        out = jnp.einsum("gecf,efd->gecd", h, w2.astype(self.dtype))
         out = self._constrain(out)
         # expert slots → tokens (the reverse all-to-all), gate-weighted
-        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), out)
+        y = jnp.einsum("gtec,gecd->gtd", combine.astype(self.dtype), out)
         return y.reshape(b, s, d)
 
     def _constrain(self, slots):
         if self.mesh is None:
             return slots
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from tpudist.mesh import DATA_AXIS, FSDP_AXIS
 
         return jax.lax.with_sharding_constraint(
-            slots, NamedSharding(self.mesh, P(EXPERT_AXIS, None, None))
+            slots,
+            NamedSharding(
+                self.mesh, P((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None)
+            ),
         )
 
 
-def expert_parallel_size(mesh) -> int:
-    return mesh.shape[EXPERT_AXIS]

@@ -132,12 +132,19 @@ def make_train_step(
     grad_accum: int = 1,
     remat: bool = False,
     state_sharding=None,
+    batch_spec: Mapping[str, P] | None = None,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
 
     ``state_sharding``: a TrainState-shaped pytree of NamedShardings (see
     :func:`state_shardings_of`) for TP/FSDP runs where params are NOT fully
     replicated; defaults to the replicated DDP model.
+
+    ``batch_spec``: per-key PartitionSpec overrides for the staged batch —
+    e.g. ``{"tokens": P(('data','fsdp'), 'seq')}`` shards the sequence dim
+    over the ``seq`` axis for context-parallel (ring/Ulysses) models. Keys
+    not listed keep the default batch-dim-over-data sharding. With
+    ``grad_accum > 1`` the spec must include the leading microbatch dim.
 
     ``grad_accum > 1`` scans over ``grad_accum`` microbatches
     (batch leading dims ``[grad_accum, micro_batch, ...]``, microbatch dim
@@ -213,13 +220,14 @@ def make_train_step(
 
     repl = mesh_lib.replicated_sharding(mesh)
     out_state_sharding = state_sharding if state_sharding is not None else repl
-    if grad_accum == 1:
-        batch_sh = lambda x: mesh_lib.batch_sharding(mesh, extra_dims=x.ndim - 1)
-    else:
+
+    def batch_sh(key, x):
+        if batch_spec is not None and key in batch_spec:
+            return NamedSharding(mesh, batch_spec[key])
+        if grad_accum == 1:
+            return mesh_lib.batch_sharding(mesh, extra_dims=x.ndim - 1)
         # leading microbatch dim replicated (scanned over), second dim sharded
-        batch_sh = lambda x: NamedSharding(
-            mesh, P(None, batch_axes, *([None] * (x.ndim - 2)))
-        )
+        return NamedSharding(mesh, P(None, batch_axes, *([None] * (x.ndim - 2))))
 
     def stage(batch):
         """Host batch (flat leading dim [global_batch, ...]) → device batch.
@@ -236,7 +244,7 @@ def make_train_step(
             v = np.asarray(v)
             if grad_accum > 1:
                 v = v.reshape(grad_accum, -1, *v.shape[1:])
-            out[k] = mesh_lib.put_sharded(v, batch_sh(v))
+            out[k] = mesh_lib.put_sharded(v, batch_sh(k, v))
         return out
 
     def compiled(state, batch):
