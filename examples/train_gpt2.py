@@ -1,0 +1,221 @@
+"""GPT-2 language-model training — BASELINE.json config 5 (GPT-2 124M,
+DP + gradient accumulation, tokens/sec) and the showcase for the framework's
+parallelism axes beyond the reference's DP (SURVEY.md §2.12).
+
+The data/metrics contract matches the reference's trainer
+(/root/reference/main.py:86-117) with sequences standing in for images: the
+per-rank TSV log keeps the exact header/fields (examples_per_sec counts
+sequences), and a final tokens/sec summary is printed for the baseline table.
+
+Launch (single host):
+
+    python examples/train_gpt2.py --batch_size 8 --grad_accum 4 --JobID LM
+
+Parallelism knobs compose on the named mesh:
+
+    --tensor 4             Megatron TP over 'tensor'
+    --pipe 4 --num_micro 8 GPipe over 'pipe' (stacked blocks)
+    --cp 4 --attn ring     ring-attention context parallelism over 'seq'
+    --experts 8            MoE every other block, experts over 'expert'
+
+Multi-host works exactly like main.py: ``python -m tpudist.launch ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable as a plain script from anywhere: put the repo root (one level up)
+# on sys.path when tpudist isn't pip-installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--local_rank", type=int, default=int(os.environ.get("LOCAL_RANK", 0)))
+    p.add_argument("--batch_size", default=8, type=int,
+                   help="per-replica sequences per step (reference semantics)")
+    p.add_argument("--JobID", default="GPT2", type=str)
+    p.add_argument("--epochs", default=1, type=int)
+    p.add_argument("--lr", default=3e-4, type=float)
+    p.add_argument("--warmup_steps", default=100, type=int)
+    p.add_argument("--total_steps", default=0, type=int,
+                   help="schedule horizon; 0 = epochs x steps_per_epoch")
+    p.add_argument("--weight_decay", default=0.1, type=float)
+    p.add_argument("--clip_norm", default=1.0, type=float)
+    p.add_argument("--grad_accum", default=1, type=int)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint the forward (HBM for FLOPs)")
+    # model size
+    p.add_argument("--hidden_dim", default=768, type=int)
+    p.add_argument("--depth", default=12, type=int)
+    p.add_argument("--num_heads", default=12, type=int)
+    p.add_argument("--vocab_size", default=50257, type=int)
+    p.add_argument("--seq_len", default=1024, type=int)
+    # data: a flat token file (.npy int32/uint16) or synthetic
+    p.add_argument("--tokens", default=None, type=str,
+                   help="path to a 1-D token array (.npy); default synthetic")
+    p.add_argument("--synthetic_tokens", default=2_000_000, type=int)
+    # parallelism (sizes of the mesh axes; data gets the rest)
+    p.add_argument("--tensor", default=1, type=int)
+    p.add_argument("--pipe", default=1, type=int)
+    p.add_argument("--num_micro", default=8, type=int)
+    p.add_argument("--cp", default=1, type=int, help="'seq' (context) axis size")
+    p.add_argument("--experts", default=0, type=int, help="MoE experts (0=dense)")
+    p.add_argument("--expert_axis", default=0, type=int,
+                   help="'expert' mesh axis size (0 → min(experts, devices))")
+    p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses"])
+    p.add_argument("--no_profiler", action="store_true")
+    p.add_argument("--log_dir", default=".", type=str)
+    p.add_argument("--checkpoint_dir", default=None, type=str)
+    p.add_argument("--checkpoint_every", default=0, type=int)
+    p.add_argument("--no_resume", action="store_true")
+    return p.parse_args(argv)
+
+
+def load_tokens(args):
+    """Flat token stream → {'tokens': [N, seq_len]} windows."""
+    import numpy as np
+
+    if args.tokens:
+        flat = np.load(args.tokens, mmap_mode="r")
+        flat = np.asarray(flat, np.int32)
+        if flat.max() >= args.vocab_size:
+            raise SystemExit(
+                f"token id {flat.max()} >= vocab_size {args.vocab_size}"
+            )
+    else:
+        rng = np.random.Generator(np.random.PCG64(0))
+        flat = rng.integers(
+            0, args.vocab_size, args.synthetic_tokens
+        ).astype(np.int32)
+    n = len(flat) // args.seq_len
+    return {"tokens": flat[: n * args.seq_len].reshape(n, args.seq_len)}
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if os.environ.get("TPUDIST_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpudist import init_from_env
+    from tpudist import mesh as mesh_lib
+    from tpudist.data.loader import DataLoader
+    from tpudist.data.sampler import DistributedSampler
+    from tpudist.models.gpt2 import GPT2, PipelinedGPT2
+    from tpudist.optim import make_optimizer, warmup_cosine
+    from tpudist.train import fit, lm_loss
+
+    ctx = init_from_env()
+    n_dev = jax.device_count()
+    if args.expert_axis:
+        expert_axis = args.expert_axis
+    elif args.experts:
+        # largest axis that divides both the expert count (weights shard
+        # evenly) and the devices left over from the other model axes
+        avail = max(n_dev // (args.tensor * args.pipe * args.cp), 1)
+        expert_axis = max(
+            d for d in range(1, min(args.experts, avail) + 1)
+            if args.experts % d == 0 and avail % d == 0
+        )
+    else:
+        expert_axis = 1
+    mesh = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(
+            data=-1, tensor=args.tensor, pipe=args.pipe, seq=args.cp,
+            expert=max(expert_axis, 1),
+        )
+    )
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+
+    if args.pipe > 1:
+        # PipelinedGPT2 builds its blocks with tp=False (shard_map manual
+        # mesh), so tensor metadata would be silently inert — reject rather
+        # than mislead
+        if args.experts or args.attn in ("ring", "ulysses") or args.tensor > 1:
+            raise SystemExit(
+                "--pipe composes with data parallelism only (stacked blocks)"
+            )
+        model = PipelinedGPT2(
+            mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
+            max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
+            depth=args.depth, num_heads=args.num_heads, dtype=dtype,
+        )
+    else:
+        model = GPT2(
+            vocab_size=args.vocab_size, max_seq_len=args.seq_len,
+            hidden_dim=args.hidden_dim, depth=args.depth,
+            num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
+            num_experts=args.experts, mesh=mesh,
+        )
+
+    data = load_tokens(args)
+    # --batch_size is per data-parallel replica (reference semantics); model-
+    # parallel axes (tensor/pipe/seq/expert) don't multiply the batch
+    local_replicas = max(
+        mesh_lib.data_parallel_size(mesh) // ctx.process_count, 1
+    )
+    per_process_batch = args.batch_size * local_replicas * args.grad_accum
+    sampler = DistributedSampler(
+        len(data["tokens"]), num_replicas=ctx.process_count,
+        rank=ctx.process_index,
+    )
+    loader = DataLoader(data, per_process_batch, sampler=sampler)
+
+    steps_per_epoch = len(loader)
+    total = args.total_steps or max(args.epochs * steps_per_epoch, 1)
+    tx = make_optimizer(
+        warmup_cosine(args.lr, warmup_steps=min(args.warmup_steps, total // 2),
+                      total_steps=total),
+        weight_decay=args.weight_decay, clip_norm=args.clip_norm,
+    )
+
+    batch_spec = None
+    if args.cp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        shape = (
+            P((mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS), mesh_lib.SEQUENCE_AXIS)
+            if args.grad_accum == 1
+            else P(None, (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
+                   mesh_lib.SEQUENCE_AXIS)
+        )
+        batch_spec = {"tokens": shape}
+
+    import time
+
+    t0 = time.time()
+    state, losses = fit(
+        model, tx, loader,
+        epochs=args.epochs, mesh=mesh,
+        job_id=args.JobID, batch_size=args.batch_size,
+        world_size=ctx.world_size, global_rank=ctx.process_index,
+        loss_fn=lm_loss, input_key="tokens", label_key="tokens",
+        grad_accum=args.grad_accum, remat=args.remat,
+        batch_spec=batch_spec,
+        profile=not args.no_profiler, log_dir=args.log_dir,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=not args.no_resume,
+    )
+    wall = time.time() - t0
+    n_steps = len(losses)
+    if n_steps and ctx.process_index == 0:
+        seqs = n_steps * args.batch_size * ctx.world_size * args.grad_accum
+        print(
+            f"tokens/sec: {seqs * args.seq_len / wall:.1f} "
+            f"(global, incl. compile) steps={n_steps} final_loss={losses[-1]:.4f}"
+        )
+    return state, losses
+
+
+if __name__ == "__main__":
+    main()

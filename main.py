@@ -46,6 +46,10 @@ def parse_args(argv=None):
     parser.add_argument("--data_root", default="dataset", type=str)
     parser.add_argument("--synthetic_size", default=2048, type=int)
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    parser.add_argument("--weight_decay", default=0.0, type=float,
+                        help="decoupled (AdamW) weight decay, 1-D params excluded")
+    parser.add_argument("--clip_norm", default=None, type=float,
+                        help="global gradient-norm clip")
     parser.add_argument("--grad_accum", default=1, type=int)
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
@@ -107,7 +111,12 @@ def main(argv=None):
     )
     loader = DataLoader(data, per_process_batch, sampler=sampler, transform=to_tensor)
 
-    tx = optax.adam(args.lr)
+    from tpudist.optim import make_optimizer
+
+    # defaults reproduce the reference's Adam(lr=1e-3) (main.py:80) exactly
+    tx = make_optimizer(
+        args.lr, weight_decay=args.weight_decay, clip_norm=args.clip_norm
+    )
     state, losses = fit(
         model, tx, loader,
         epochs=args.epochs, mesh=mesh,

@@ -1,0 +1,136 @@
+"""Mixed precision policy (tpudist.amp) and optimizer factory
+(tpudist.optim)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpudist.amp import BF16_COMPUTE, all_finite, policy_for, skip_nonfinite, skipped_steps
+from tpudist.optim import make_optimizer, decay_mask, warmup_cosine
+
+
+def test_policy_casts_floats_only():
+    tree = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+    out = BF16_COMPUTE.cast_to_compute(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["i"].dtype == jnp.int32
+    back = BF16_COMPUTE.cast_to_param(out)
+    assert back["w"].dtype == jnp.float32
+
+
+def test_policy_for():
+    assert policy_for(True).compute_dtype == jnp.bfloat16
+    assert policy_for(False).compute_dtype == jnp.float32
+
+
+def test_all_finite():
+    assert bool(all_finite({"a": jnp.ones(3), "i": jnp.arange(3)}))
+    assert not bool(all_finite({"a": jnp.array([1.0, np.nan])}))
+    assert not bool(all_finite({"a": jnp.array([np.inf])}))
+
+
+def test_skip_nonfinite_skips_and_counts():
+    tx = skip_nonfinite(optax.adam(0.1))
+    params = {"w": jnp.ones((2,))}
+    state = tx.init(params)
+
+    good = {"w": jnp.full((2,), 0.5)}
+    bad = {"w": jnp.array([1.0, np.nan])}
+
+    up, state = tx.update(good, state, params)
+    assert bool(all_finite(up)) and float(jnp.abs(up["w"]).sum()) > 0
+    assert skipped_steps(state) == 0
+    mu_after_good = jax.tree_util.tree_leaves(state[0])[0]
+
+    up, state = tx.update(bad, state, params)
+    np.testing.assert_array_equal(np.asarray(up["w"]), 0.0)
+    assert skipped_steps(state) == 1
+    # inner optimizer state untouched by the skipped step
+    mu_after_bad = jax.tree_util.tree_leaves(state[0])[0]
+    np.testing.assert_array_equal(np.asarray(mu_after_good), np.asarray(mu_after_bad))
+
+    up, state = tx.update(good, state, params)
+    assert float(jnp.abs(up["w"]).sum()) > 0
+    assert skipped_steps(state) == 1
+
+
+def test_skip_nonfinite_trains_through_a_spike():
+    """A model step with one poisoned batch recovers instead of NaN-ing out."""
+    tx = skip_nonfinite(optax.adam(0.1))
+    params = jnp.array([2.0])
+    state = tx.init(params)
+
+    def grads_of(p, x):
+        return jax.grad(lambda p: jnp.sum((p * x) ** 2))(p)
+
+    for x in [1.0, np.nan, 1.0, 1.0]:
+        g = grads_of(params, jnp.array([x]))
+        up, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, up)
+    assert np.isfinite(float(params[0]))
+    assert abs(float(params[0])) < 2.0  # the finite steps made progress
+
+
+def test_warmup_cosine_shape():
+    sched = warmup_cosine(1e-3, warmup_steps=10, total_steps=100)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-6)
+    assert float(sched(100)) < 1e-5
+    # monotone up during warmup
+    assert float(sched(5)) < float(sched(9))
+
+
+def test_decay_mask():
+    params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+              "ln": {"scale": jnp.ones((4,))}}
+    mask = decay_mask(params)
+    assert mask["dense"]["kernel"] is True
+    assert mask["dense"]["bias"] is False
+    assert mask["ln"]["scale"] is False
+
+
+def test_make_optimizer_default_is_reference_adam():
+    """make_optimizer() must reproduce Adam(lr=1e-3) exactly — the
+    reference's optimizer (/root/reference/main.py:80)."""
+    params = {"w": jnp.ones((3, 3))}
+    grads = {"w": jnp.full((3, 3), 0.1)}
+    a, b = make_optimizer(), optax.adam(1e-3)
+    ua, _ = a.update(grads, a.init(params), params)
+    ub, _ = b.update(grads, b.init(params), params)
+    np.testing.assert_array_equal(np.asarray(ua["w"]), np.asarray(ub["w"]))
+
+
+def test_make_optimizer_clip_and_decay():
+    params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}}
+    tx = make_optimizer(1e-2, weight_decay=0.1, clip_norm=1.0)
+    state = tx.init(params)
+    big = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 100.0), params)
+    up, _ = tx.update(big, state, params)
+    # clipped: update magnitudes bounded (adam normalizes anyway; just finite)
+    assert bool(all_finite(up))
+
+
+def test_make_optimizer_in_train_step():
+    """The full factory chain (clip + adamw + skip_nonfinite) drives the
+    compiled train step."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models import resnet18
+    from tpudist.data.cifar import synthetic_cifar, to_tensor
+    from tpudist.train import create_train_state, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    model = resnet18(num_classes=10, small_inputs=True)
+    tx = make_optimizer(
+        warmup_cosine(1e-3, warmup_steps=2, total_steps=20),
+        weight_decay=1e-4, clip_norm=1.0, skip_nonfinite_updates=True,
+    )
+    state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
+    step = make_train_step(model, tx, mesh)
+    batch = to_tensor(synthetic_cifar(n=16, num_classes=10))
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -26,6 +26,8 @@ from tpudist.mesh import MeshConfig, create_mesh, batch_sharding, replicated_sha
 from tpudist.distributed import DistributedContext, init_from_env, reduce_loss
 from tpudist.data.sampler import DistributedSampler
 from tpudist.store import TCPStore
+from tpudist.amp import Policy, policy_for, skip_nonfinite
+from tpudist.optim import make_optimizer, warmup_cosine
 
 __version__ = "0.1.0"
 
@@ -39,5 +41,10 @@ __all__ = [
     "reduce_loss",
     "DistributedSampler",
     "TCPStore",
+    "Policy",
+    "policy_for",
+    "skip_nonfinite",
+    "make_optimizer",
+    "warmup_cosine",
     "__version__",
 ]

@@ -1,0 +1,112 @@
+"""Mixed precision policy for TPU training.
+
+The reference trains in fp32 end-to-end (no autocast/AMP anywhere in
+/root/reference/main.py — SURVEY.md §2.12 lists "AMP/bf16 autocast" as
+explicitly absent); BASELINE.json config 4 (ViT-B/16) demands a bf16 path.
+The TPU-native story is simpler than CUDA AMP: MXU matmuls take bf16 inputs
+natively and accumulate in fp32, so there is no fp16 loss-scaling dance —
+the policy is "fp32 master params, bf16 compute, fp32 logits/loss", which
+the flax modules implement via their ``dtype`` field (params are created in
+fp32 and cast per-op). This module gives that convention a name, plus
+guards for the rare bf16 overflow spike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype roles for a training step.
+
+    ``param_dtype``: master copy precision (optimizer state math);
+    ``compute_dtype``: forward/backward matmul inputs;
+    ``output_dtype``: logits/loss precision.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return _cast_floats(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floats(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floats(tree, self.output_dtype)
+
+
+FP32 = Policy()
+BF16_COMPUTE = Policy(compute_dtype=jnp.bfloat16)
+
+
+def policy_for(bf16: bool) -> Policy:
+    return BF16_COMPUTE if bf16 else FP32
+
+
+def _cast_floats(tree, dtype):
+    def cast(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x, dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def all_finite(tree) -> jax.Array:
+    """Scalar bool: every float leaf of ``tree`` is finite."""
+    leaves = [
+        jnp.all(jnp.isfinite(x))
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+def skip_nonfinite(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Wrap an optimizer so steps with non-finite gradients become no-ops.
+
+    A bf16 overflow spike (or a data glitch) then skips one update instead
+    of poisoning params and Adam moments with NaNs forever. The skip count
+    is kept in the wrapper's state for observability.
+    """
+
+    def init(params):
+        return (tx.init(params), jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        inner_state, skipped = state
+        ok = all_finite(grads)
+        safe = jax.tree_util.tree_map(
+            lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
+        )
+        new_updates, new_inner = tx.update(safe, inner_state, params)
+        # non-finite step: zero updates, optimizer state unchanged
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(ok, u, jnp.zeros_like(u)), new_updates
+        )
+        inner = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old)
+            if jnp.issubdtype(jnp.asarray(new).dtype, jnp.inexact)
+            or jnp.issubdtype(jnp.asarray(new).dtype, jnp.integer)
+            else new,
+            new_inner, inner_state,
+        )
+        return updates, (inner, skipped + jnp.where(ok, 0, 1))
+
+    return optax.GradientTransformation(init, update)
+
+
+def skipped_steps(opt_state) -> int:
+    """Read the skip counter out of a :func:`skip_nonfinite` state."""
+    return int(opt_state[1])

@@ -62,6 +62,11 @@ class Block(nn.Module):
             # context-parallel attention over the 'seq' mesh axis
             # (tpudist.parallel.cp); activations arrive sequence-sharded and
             # the shard_map keeps them that way — requires ``mesh``
+            if self.mesh is None:
+                raise ValueError(
+                    f"attn_impl={self.attn_impl!r} needs the model's mesh= "
+                    "field set (the shard_map runs over its 'seq' axis)"
+                )
             from tpudist.parallel.cp import ring_attention, ulysses_attention
 
             cp_fn = ring_attention if self.attn_impl == "ring" else ulysses_attention

@@ -274,6 +274,8 @@ def fit(
     input_key: str = "image",
     label_key: str = "label",
     grad_accum: int = 1,
+    remat: bool = False,
+    batch_spec: Mapping[str, P] | None = None,
     profile: bool = True,
     prefetch_depth: int = 2,
     log_dir: str = ".",
@@ -309,13 +311,19 @@ def fit(
         batch_size = train_loader.batch_size // jax.local_device_count()
 
     sample = next(iter(train_loader))
-    state = create_train_state(
-        model, seed, jnp.asarray(sample[input_key][:1]), tx, mesh
+    # init sample batch = the mesh's replica count, not 1: models with manual
+    # (shard_map) axes — ring/Ulysses attention — refuse traces whose batch
+    # doesn't divide the mesh; zeros keep init cheap and content-independent
+    sample_in = np.asarray(sample[input_key])
+    init_input = jnp.zeros(
+        (mesh_lib.data_parallel_size(mesh), *sample_in.shape[1:]),
+        sample_in.dtype,
     )
+    state = create_train_state(model, seed, init_input, tx, mesh)
     step = make_train_step(
         model, tx, mesh,
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
-        grad_accum=grad_accum,
+        grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
         # here would all-gather a TP model's params on the first step
