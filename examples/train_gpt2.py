@@ -192,12 +192,16 @@ def main(argv=None):
 
     import time
 
+    # throughput accounting counts data-parallel replicas (the reference's
+    # world = one replica per GPU); model-parallel axes don't multiply it
+    dp_size = mesh_lib.data_parallel_size(mesh)
+
     t0 = time.time()
     state, losses = fit(
         model, tx, loader,
         epochs=args.epochs, mesh=mesh,
         job_id=args.JobID, batch_size=args.batch_size,
-        world_size=ctx.world_size, global_rank=ctx.process_index,
+        world_size=dp_size, global_rank=ctx.process_index,
         loss_fn=lm_loss, input_key="tokens", label_key="tokens",
         grad_accum=args.grad_accum, remat=args.remat,
         batch_spec=batch_spec,
@@ -209,7 +213,7 @@ def main(argv=None):
     wall = time.time() - t0
     n_steps = len(losses)
     if n_steps and ctx.process_index == 0:
-        seqs = n_steps * args.batch_size * ctx.world_size * args.grad_accum
+        seqs = n_steps * args.batch_size * dp_size * args.grad_accum
         print(
             f"tokens/sec: {seqs * args.seq_len / wall:.1f} "
             f"(global, incl. compile) steps={n_steps} final_loss={losses[-1]:.4f}"

@@ -59,6 +59,10 @@ def parse_args(argv=None):
     parser.add_argument("--checkpoint_every", default=0, type=int,
                         help="steps between checkpoints (0 = end of run only)")
     parser.add_argument("--no_resume", action="store_true")
+    parser.add_argument("--eval", action="store_true",
+                        help="run the top-1 eval pass after training — the "
+                        "reference's dormant eval loop "
+                        "(/root/reference/main.py:119-130), alive")
     return parser.parse_args(argv)
 
 
@@ -71,7 +75,6 @@ def main(argv=None):
 
     import jax
     import jax.numpy as jnp
-    import optax
 
     from tpudist import init_from_env, create_mesh
     from tpudist.data.cifar import load_cifar, synthetic_cifar, to_tensor
@@ -131,6 +134,32 @@ def main(argv=None):
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
     )
+
+    if args.eval:
+        from tpudist.train import evaluate
+
+        # the reference's val loader is unsharded (every rank sees the full
+        # set, /root/reference/main.py:56-63); same here, and only rank 0
+        # reports — matching the commented-out accuracy print (main.py:129)
+        if args.dataset == "synthetic":
+            val = synthetic_cifar(args.synthetic_size // 4 or 1, num_classes=100)
+        else:
+            val = load_cifar(args.data_root, dataset=args.dataset, train=False)
+        # drop_remainder (default) keeps batches mesh-divisible; shrink the
+        # eval batch when the val set is smaller than a full train batch so
+        # the loader can't silently yield zero batches (acc would read 0.0)
+        n_local = jax.local_device_count()
+        n_val = len(val["label"])
+        eval_batch = min(per_process_batch, n_val // n_local * n_local)
+        if eval_batch == 0:
+            raise SystemExit(
+                f"val set ({n_val} samples) smaller than one batch per "
+                f"local device ({n_local}); nothing to evaluate"
+            )
+        val_loader = DataLoader(val, eval_batch, transform=to_tensor)
+        acc = evaluate(model, state, val_loader, mesh)
+        if ctx.process_index == 0:
+            print(f"Accuracy: {acc:.4f}")
     return state, losses
 
 

@@ -109,3 +109,32 @@ def test_loss_decreases_under_dp():
         if first is None:
             first = last
     assert last < first
+
+
+def test_evaluate_top1_accuracy():
+    """The alive version of the reference's dormant eval loop
+    (/root/reference/main.py:119-130): top-1 accuracy over a loader."""
+    import optax
+
+    from tpudist.data.cifar import synthetic_cifar, to_tensor
+    from tpudist.data.loader import DataLoader
+    from tpudist.models import resnet18
+    from tpudist.train import create_train_state, evaluate, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    model = resnet18(num_classes=10, small_inputs=True)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
+
+    data = synthetic_cifar(n=32, num_classes=10)
+    loader = DataLoader(data, 16, transform=to_tensor)
+    acc = evaluate(model, state, loader, mesh)
+    assert 0.0 <= acc <= 1.0
+
+    # memorize the 32 samples; accuracy must beat the random-init model's
+    step = make_train_step(model, tx, mesh)
+    batch = to_tensor({k: v for k, v in data.items()})
+    for _ in range(30):
+        state, _ = step(state, batch)
+    acc_trained = evaluate(model, state, loader, mesh)
+    assert acc_trained > max(acc, 0.5), (acc, acc_trained)
