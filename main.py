@@ -145,18 +145,12 @@ def main(argv=None):
             val = synthetic_cifar(args.synthetic_size // 4 or 1, num_classes=100)
         else:
             val = load_cifar(args.data_root, dataset=args.dataset, train=False)
-        # drop_remainder (default) keeps batches mesh-divisible; shrink the
-        # eval batch when the val set is smaller than a full train batch so
-        # the loader can't silently yield zero batches (acc would read 0.0)
-        n_local = jax.local_device_count()
-        n_val = len(val["label"])
-        eval_batch = min(per_process_batch, n_val // n_local * n_local)
-        if eval_batch == 0:
-            raise SystemExit(
-                f"val set ({n_val} samples) smaller than one batch per "
-                f"local device ({n_local}); nothing to evaluate"
-            )
-        val_loader = DataLoader(val, eval_batch, transform=to_tensor)
+        # drop_remainder=False + evaluate's pad-and-mask scores the FULL val
+        # set (the reference's loop covers every sample too); no tail drop
+        eval_batch = min(per_process_batch, len(val["label"]))
+        val_loader = DataLoader(
+            val, eval_batch, transform=to_tensor, drop_remainder=False
+        )
         acc = evaluate(model, state, val_loader, mesh)
         if ctx.process_index == 0:
             print(f"Accuracy: {acc:.4f}")

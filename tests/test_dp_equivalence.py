@@ -138,3 +138,27 @@ def test_evaluate_top1_accuracy():
         state, _ = step(state, batch)
     acc_trained = evaluate(model, state, loader, mesh)
     assert acc_trained > max(acc, 0.5), (acc, acc_trained)
+
+
+def test_evaluate_scores_ragged_tail():
+    """drop_remainder=False + pad-and-mask: every val sample is scored even
+    when the final batch doesn't divide the 8-device mesh."""
+    import optax
+
+    from tpudist.data.cifar import synthetic_cifar, to_tensor
+    from tpudist.data.loader import DataLoader
+    from tpudist.models import resnet18
+    from tpudist.train import create_train_state, evaluate
+
+    mesh = mesh_lib.create_mesh()
+    model = resnet18(num_classes=10, small_inputs=True)
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 32, 32, 3)), optax.adam(1e-3), mesh
+    )
+    # 35 samples, batch 16 → batches of 16, 16, 3 (3 not divisible by 8)
+    data = synthetic_cifar(n=35, num_classes=10)
+    ragged = DataLoader(data, 16, transform=to_tensor, drop_remainder=False)
+    flat = DataLoader(data, 35, transform=to_tensor, drop_remainder=False)
+    acc_ragged = evaluate(model, state, ragged, mesh)
+    acc_flat = evaluate(model, state, flat, mesh)
+    assert abs(acc_ragged - acc_flat) < 1e-9  # identical sample set scored

@@ -47,7 +47,7 @@ def _interpret() -> bool:
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref,  # [1,1,bq,d], [1,1,bk,d], [1,1,bk,d]
-    o_ref, lse_ref,       # [1,1,bq,d], [1,1,bq]
+    o_ref, lse_ref,       # [1,1,bq,d], [1,1,bq,128] (lane-padded, see _flash_fwd)
     m_scr, l_scr, acc_scr,  # VMEM f32: [bq,128], [bq,128], [bq,d]
     *, sm_scale: float, causal: bool, block_q: int, block_k: int,
 ):
@@ -108,8 +108,8 @@ def _fwd_kernel(
         # keeps the kernel total-function)
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        lse = m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
-        lse_ref[0, 0] = lse
+        lse = m_scr[:, :1] + jnp.log(jnp.where(l == 0.0, 1.0, l))  # [bq, 1]
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
@@ -132,9 +132,12 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
+    # lse rides a lane-padded [b,h,s_q,128] buffer: a [*, *, bq] block would
+    # put a size-1 dim in the sublane slot, which Mosaic's (8,128) tiling
+    # rejects on real TPUs (interpret mode doesn't enforce it)
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
-        jax.ShapeDtypeStruct((b, h, s_q), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, s_q, 128), jnp.float32),
     ]
     o, lse = pl.pallas_call(
         kernel,
@@ -146,7 +149,7 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=out_shape,
         scratch_shapes=[
@@ -156,7 +159,7 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
         ],
         interpret=_interpret(),
     )(q, k, v)
-    return o, lse
+    return o, lse[..., 0]
 
 
 def _bwd_blockwise(res, g, *, causal, sm_scale, block_k):

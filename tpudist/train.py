@@ -436,19 +436,36 @@ def fit(
 def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
              *, input_key: str = "image", label_key: str = "label") -> float:
     """Top-1 accuracy over a loader — the reference's dormant eval pass
-    (/root/reference/main.py:119-130), alive and tested here."""
+    (/root/reference/main.py:119-130), alive and tested here.
+
+    Scores EVERY sample: a final batch that doesn't divide the mesh's
+    replica count is padded (repeating the last row) and the padding is
+    masked out of the correct-count, so no val tail is silently dropped.
+    """
     mesh = mesh or mesh_lib.create_mesh()
-    repl = mesh_lib.replicated_sharding(mesh)
+    dp = mesh_lib.data_parallel_size(mesh)
 
     @jax.jit
-    def count_correct(params, batch_stats, batch):
+    def count_correct(params, batch_stats, batch, mask):
         variables = {"params": params, "batch_stats": batch_stats}
         logits = model.apply(variables, batch[input_key], train=False)
-        return jnp.sum(jnp.argmax(logits, axis=-1) == batch[label_key])
+        hit = jnp.argmax(logits, axis=-1) == batch[label_key]
+        return jnp.sum(jnp.where(mask, hit, False))
 
     cnt, total = 0, 0
     for batch in loader:
+        n = int(np.asarray(batch[label_key]).shape[0])
+        pad = -n % dp
+        if pad:
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                for k, v in ((k, np.asarray(v)) for k, v in batch.items())
+            }
+        mask = np.arange(n + pad) < n
         batch = mesh_lib.shard_batch(batch, mesh)
-        cnt += int(count_correct(state.params, state.batch_stats, batch))
-        total += int(batch[label_key].shape[0])
+        mask = mesh_lib.put_sharded(
+            mask, mesh_lib.batch_sharding(mesh, extra_dims=0)
+        )
+        cnt += int(count_correct(state.params, state.batch_stats, batch, mask))
+        total += n
     return cnt / max(total, 1)
