@@ -454,12 +454,13 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
 
     cnt, total = 0, 0
     for batch in loader:
-        n = int(np.asarray(batch[label_key]).shape[0])
+        batch = {k: np.asarray(v) for k, v in batch.items()}
+        n = batch[label_key].shape[0]
         pad = -n % dp
         if pad:
             batch = {
                 k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
-                for k, v in ((k, np.asarray(v)) for k, v in batch.items())
+                for k, v in batch.items()
             }
         mask = np.arange(n + pad) < n
         batch = mesh_lib.shard_batch(batch, mesh)
@@ -467,5 +468,8 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
             mask, mesh_lib.batch_sharding(mesh, extra_dims=0)
         )
         cnt += int(count_correct(state.params, state.batch_stats, batch, mask))
-        total += n
+        # multi-process: every process contributes its batch copy as a shard,
+        # so the summed hit-count is over process_count × n rows — the
+        # denominator must match or accuracy inflates by process_count
+        total += n * jax.process_count()
     return cnt / max(total, 1)
