@@ -44,3 +44,26 @@ def test_global_batch_sizes():
     assert per_process == 64
     with pytest.raises(ValueError):
         mesh_lib.global_batch_sizes(30, m)
+
+
+def test_topology_mesh_uses_all_devices_once():
+    """Topology-aware placement is a reordering, never a resampling: every
+    visible device appears exactly once regardless of mesh shape."""
+    for cfg in (
+        mesh_lib.MeshConfig(),
+        mesh_lib.MeshConfig(data=2, tensor=4),
+        mesh_lib.MeshConfig(data=2, pipe=2, seq=2),
+    ):
+        mesh = mesh_lib.create_mesh(cfg)
+        assert sorted(d.id for d in mesh.devices.flat) == sorted(
+            d.id for d in jax.devices()
+        )
+        assert len(set(mesh.devices.flat)) == jax.device_count()
+
+
+def test_explicit_devices_keep_caller_order():
+    devices = jax.devices()[:4][::-1]
+    mesh = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(data=4), devices=devices
+    )
+    assert [d.id for d in mesh.devices.flat] == [d.id for d in devices]

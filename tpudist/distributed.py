@@ -64,6 +64,14 @@ def init_from_env(*, allow_single_process: bool = True) -> DistributedContext:
     ``WORLD_SIZE`` ≤ 1 or absent, runs single-process (all local devices).
     """
     global _initialized
+    # opt-in persistent XLA compile cache: first compile of the train step is
+    # tens of seconds on TPU; restarts (and checkpoint resumes) skip it.
+    # JAX's own knobs win if the user already configured them.
+    cache_dir = os.environ.get("TPUDIST_COMPILE_CACHE")
+    if cache_dir and not jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     nproc = int(os.environ.get("WORLD_SIZE", "1"))
     rank = int(os.environ.get("RANK", "0"))
     if nproc > 1:

@@ -12,11 +12,14 @@ tensor/pipeline/sequence axes can be added without reshaping the framework.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Mapping, Sequence
 
 import jax
 import numpy as np
+
+logger = logging.getLogger(__name__)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names, in mesh order. Data-parallel is the outermost axis so
@@ -83,14 +86,48 @@ def create_mesh(
 
     Axes of size 1 are kept (named, size-1) so sharding specs can always
     mention every canonical axis; XLA elides trivial collectives.
+
+    With ``devices`` unset, placement is topology-aware: ``mesh_utils``
+    orders chips so neighboring mesh coordinates are ICI neighbors (the
+    collectives ride ICI rings, not arbitrary hops), and on multi-slice
+    pods the ``data`` axis is laid across slices so only the gradient
+    all-reduce crosses DCN while the model axes stay inside a slice.
+    An explicit ``devices`` list keeps the caller's ordering verbatim.
     """
     config = config or MeshConfig()
+    explicit = devices is not None
     if devices is None:
         devices = jax.devices()
     sizes = config.axis_sizes(len(devices))
     shape = tuple(sizes[a] for a in _AXIS_ORDER)
-    dev_array = np.asarray(devices).reshape(shape)
+    dev_array = None
+    if not explicit:
+        dev_array = _topology_mesh(shape, devices)
+    if dev_array is None:
+        dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, _AXIS_ORDER)
+
+
+def _topology_mesh(shape: tuple[int, ...], devices) -> np.ndarray | None:
+    """ICI/DCN-aware device array, or None to fall back to plain reshape."""
+    try:
+        from jax.experimental import mesh_utils
+
+        slices = {getattr(d, "slice_index", 0) for d in devices}
+        n_slices = len(slices)
+        data = shape[0]
+        if n_slices > 1 and data % n_slices == 0:
+            # DCN carries only the outer slice-count factor of 'data'; every
+            # other axis (and the intra-slice share of 'data') stays on ICI
+            dcn = (n_slices,) + (1,) * (len(shape) - 1)
+            per_slice = (data // n_slices,) + shape[1:]
+            return mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=devices
+            )
+        return mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception as e:  # unusual topologies: ordering is only a perf hint
+        logger.info("topology-aware mesh unavailable (%s); using device order", e)
+        return None
 
 
 def batch_sharding(mesh: Mesh, *, extra_dims: int = 3) -> NamedSharding:
