@@ -49,6 +49,10 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint the forward (HBM for FLOPs)")
+    p.add_argument("--chunked_ce", default=0, type=int,
+                   help="sequence-chunked weight-tied CE (chunk size); the "
+                   "[B,S,V] logits never materialize — raises the max batch/"
+                   "seq_len per chip (dense models only)")
     # model size
     p.add_argument("--hidden_dim", default=768, type=int)
     p.add_argument("--depth", default=12, type=int)
@@ -178,6 +182,14 @@ def main(argv=None):
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
 
+    forward_loss = None
+    if args.chunked_ce:
+        from tpudist.models.gpt2 import chunked_lm_forward
+
+        if args.pipe > 1 or args.experts:
+            raise SystemExit("--chunked_ce supports the dense GPT2 path only")
+        forward_loss = chunked_lm_forward(model, chunk=args.chunked_ce)
+
     batch_spec = None
     if args.cp > 1:
         from jax.sharding import PartitionSpec as P
@@ -204,7 +216,7 @@ def main(argv=None):
         world_size=dp_size, global_rank=ctx.process_index,
         loss_fn=lm_loss, input_key="tokens", label_key="tokens",
         grad_accum=args.grad_accum, remat=args.remat,
-        batch_spec=batch_spec,
+        batch_spec=batch_spec, forward_loss=forward_loss,
         profile=not args.no_profiler, log_dir=args.log_dir,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

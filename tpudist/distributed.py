@@ -67,10 +67,11 @@ def init_from_env(*, allow_single_process: bool = True) -> DistributedContext:
     # opt-in persistent XLA compile cache: first compile of the train step is
     # tens of seconds on TPU; restarts (and checkpoint resumes) skip it.
     # JAX's own knobs win if the user already configured them.
+    # (only the dir is set — thresholds like min-compile-time stay whatever
+    # the user configured via JAX's own env vars)
     cache_dir = os.environ.get("TPUDIST_COMPILE_CACHE")
     if cache_dir and not jax.config.jax_compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     nproc = int(os.environ.get("WORLD_SIZE", "1"))
     rank = int(os.environ.get("RANK", "0"))

@@ -133,8 +133,14 @@ def make_train_step(
     remat: bool = False,
     state_sharding=None,
     batch_spec: Mapping[str, P] | None = None,
+    forward_loss: Callable | None = None,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
+
+    ``forward_loss``: optional fused ``(params, batch_stats, batch) →
+    (loss, new_stats)`` replacing the default logits+loss_fn composition —
+    e.g. :func:`tpudist.models.gpt2.chunked_lm_forward`, which keeps the LM
+    head's logits from ever materializing.
 
     ``state_sharding``: a TrainState-shaped pytree of NamedShardings (see
     :func:`state_shardings_of`) for TP/FSDP runs where params are NOT fully
@@ -182,6 +188,8 @@ def make_train_step(
         loss = loss_fn(logits, batch[label_key]) + aux
         return loss, new_stats
 
+    if forward_loss is not None:
+        forward = forward_loss
     if remat:
         forward = jax.checkpoint(forward)
 
@@ -276,6 +284,7 @@ def fit(
     grad_accum: int = 1,
     remat: bool = False,
     batch_spec: Mapping[str, P] | None = None,
+    forward_loss: Callable | None = None,
     profile: bool = True,
     prefetch_depth: int = 2,
     log_dir: str = ".",
@@ -324,6 +333,7 @@ def fit(
         model, tx, mesh,
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
+        forward_loss=forward_loss,
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
         # here would all-gather a TP model's params on the first step
