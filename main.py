@@ -80,7 +80,7 @@ def main(argv=None):
     from tpudist.data.cifar import load_cifar, synthetic_cifar, to_tensor
     from tpudist.data.loader import DataLoader
     from tpudist.data.sampler import DistributedSampler
-    from tpudist.models import resnet18, resnet50, vit_b16, gpt2_124m
+    from tpudist.models import resnet18, resnet50, vit_b16
     from tpudist.train import fit
 
     ctx = init_from_env()

@@ -87,3 +87,10 @@ def test_chunked_train_step_on_mesh():
 def test_chunked_rejects_moe():
     with pytest.raises(ValueError):
         chunked_lm_forward(GPT2(num_experts=4))
+
+
+def test_chunked_rejects_bad_chunk():
+    with pytest.raises(ValueError):
+        chunked_lm_forward(_model(), chunk=0)
+    with pytest.raises(ValueError):
+        chunked_lm_forward(_model(), chunk=-256)

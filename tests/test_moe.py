@@ -10,7 +10,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from tpudist import mesh as mesh_lib
 from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch

@@ -6,7 +6,6 @@ pin tpudist's own native equivalents against the pure-Python semantics.
 """
 
 import multiprocessing
-import os
 
 import numpy as np
 import pytest

@@ -5,7 +5,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import PartitionSpec as P
 
 from tpudist import mesh as mesh_lib
 from tpudist.models.gpt2 import GPT2

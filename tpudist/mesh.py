@@ -14,13 +14,13 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
-from typing import Mapping, Sequence
+from typing import Sequence
 
 import jax
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names, in mesh order. Data-parallel is the outermost axis so
 # that gradient all-reduce rides the largest ring.

@@ -179,6 +179,8 @@ def chunked_lm_forward(model: GPT2, chunk: int = 256):
 
     if model.num_experts:
         raise ValueError("chunked_lm_forward does not support MoE models")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
 
     def forward_loss(params, batch_stats, batch):
         tokens = batch["tokens"]
