@@ -69,3 +69,34 @@ def test_head_dim_padding():
     ref = dot_product_attention(q, k, v, causal=True)
     assert out.shape == q.shape
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_bwd_matches_scan_bwd():
+    """The opt-in Pallas FA-2 backward (interpret mode here) must produce
+    the same dq/dk/dv as the default blockwise-scan backward."""
+    from tpudist.ops.flash_attention import (
+        _bwd_blockwise, _bwd_pallas, _flash_fwd,
+    )
+
+    rng = np.random.Generator(np.random.PCG64(9))
+    B, S, H, D = 2, 256, 2, 128
+    sm = 1.0 / np.sqrt(D)
+    for causal in (False, True):
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+            for _ in range(3)
+        )
+        o, lse = _flash_fwd(
+            q, k, v, causal=causal, sm_scale=sm, block_q=128, block_k=128
+        )
+        g = jnp.asarray(rng.normal(size=o.shape), jnp.float32)
+        res = (q, k, v, o, lse)
+        got = _bwd_pallas(
+            res, g, causal=causal, sm_scale=sm, block_q=128, block_k=128,
+            interpret=True,
+        )
+        want = _bwd_blockwise(res, g, causal=causal, sm_scale=sm, block_k=128)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
