@@ -40,7 +40,7 @@ def parse_args(argv=None):
     parser.add_argument("--lr", default=0.001, type=float)
     # capability knobs beyond the reference CLI
     parser.add_argument("--model", default="resnet50",
-                        choices=["resnet18", "resnet50", "vit_b16", "gpt2"])
+                        choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "vit_b16", "gpt2"])
     parser.add_argument("--dataset", default="cifar100",
                         choices=["cifar10", "cifar100", "synthetic"])
     parser.add_argument("--data_root", default="dataset", type=str)
@@ -80,7 +80,9 @@ def main(argv=None):
     from tpudist.data.cifar import load_cifar, synthetic_cifar, to_tensor
     from tpudist.data.loader import DataLoader
     from tpudist.data.sampler import DistributedSampler
-    from tpudist.models import resnet18, resnet50, vit_b16
+    from tpudist.models import (
+        resnet18, resnet34, resnet50, resnet101, resnet152, vit_b16,
+    )
     from tpudist.train import fit
 
     ctx = init_from_env()
@@ -96,10 +98,10 @@ def main(argv=None):
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     # reference keeps the stock 1000-way head even on CIFAR (main.py:40)
-    if args.model == "resnet50":
-        model = resnet50(dtype=dtype)
-    elif args.model == "resnet18":
-        model = resnet18(dtype=dtype)
+    resnets = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+               "resnet101": resnet101, "resnet152": resnet152}
+    if args.model in resnets:
+        model = resnets[args.model](dtype=dtype)
     elif args.model == "vit_b16":
         model = vit_b16(dtype=dtype, patch_size=4)  # 32x32 inputs -> 64 patches
     else:

@@ -1,0 +1,19 @@
+"""ResNet model family (tpudist.models.resnet) — the reference's model
+(/root/reference/main.py:40) and its depth variants."""
+
+import jax
+import jax.numpy as jnp
+
+
+def test_resnet_variant_factories():
+    """Depth variants build and the block math matches torchvision's layer
+    counts (resnet34 basic [3,4,6,3], resnet101/152 bottleneck)."""
+    from tpudist.models import resnet34, resnet101, resnet152
+
+    assert resnet34().stage_sizes == [3, 4, 6, 3]
+    assert resnet101().stage_sizes == [3, 4, 23, 3]
+    assert resnet152().stage_sizes == [3, 8, 36, 3]
+    m = resnet34(num_classes=10, small_inputs=True)
+    variables = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    logits = m.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert logits.shape == (2, 10)

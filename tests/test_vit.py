@@ -81,3 +81,4 @@ def test_vit_grad_accum_matches_flat_batch():
         state, metrics = step(state, batch)
         losses[accum] = float(metrics["loss"])
     np.testing.assert_allclose(losses[1], losses[2], rtol=1e-5)
+

@@ -127,5 +127,17 @@ def resnet18(**kw) -> ResNet:
     return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock, **kw)
 
 
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=ResNetBlock, **kw)
+
+
 def resnet50(**kw) -> ResNet:
     return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock, **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock, **kw)

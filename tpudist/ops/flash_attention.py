@@ -293,7 +293,11 @@ def _bwd_pallas(res, g, *, causal, sm_scale, block_q, block_k, interpret=None):
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )  # [b,h,sq,1]
-    lse_c = lse[..., None]  # [b,h,sq,1] — trailing singleton rides the tile
+    # trailing singleton conforms to Mosaic tiling because a block's last dim
+    # may EQUAL the array dim (1==1) instead of being 128-divisible — unlike
+    # the forward's lse OUTPUT, whose [*,*,bq] block had bq in the lane slot;
+    # validated compiled on a real v5e chip (grads match the scan backward)
+    lse_c = lse[..., None]  # [b,h,sq,1]
 
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
     kspec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0))
