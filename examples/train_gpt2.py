@@ -47,6 +47,8 @@ def parse_args(argv=None):
     p.add_argument("--clip_norm", default=1.0, type=float)
     p.add_argument("--grad_accum", default=1, type=int)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--dropout", default=0.0, type=float,
+                   help="embedding+residual dropout rate (GPT-2 paper: 0.1)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint the forward (HBM for FLOPs)")
     p.add_argument("--chunked_ce", default=0, type=int,
@@ -148,6 +150,8 @@ def main(argv=None):
             raise SystemExit(
                 "--pipe composes with data parallelism only (stacked blocks)"
             )
+        if args.dropout:
+            raise SystemExit("--dropout is not supported with --pipe")
         model = PipelinedGPT2(
             mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
             max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
@@ -158,7 +162,7 @@ def main(argv=None):
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
             hidden_dim=args.hidden_dim, depth=args.depth,
             num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
-            num_experts=args.experts, mesh=mesh,
+            num_experts=args.experts, mesh=mesh, dropout=args.dropout,
         )
 
     data = load_tokens(args)

@@ -51,6 +51,9 @@ def parse_args(argv=None):
     parser.add_argument("--clip_norm", default=None, type=float,
                         help="global gradient-norm clip")
     parser.add_argument("--grad_accum", default=1, type=int)
+    parser.add_argument("--augment", action="store_true",
+                        help="standard CIFAR augmentation (crop+flip+"
+                        "normalize); reference default is ToTensor only")
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
     parser.add_argument("--checkpoint_dir", default=None, type=str,
@@ -114,7 +117,13 @@ def main(argv=None):
     sampler = DistributedSampler(
         len(data["label"]), num_replicas=ctx.process_count, rank=ctx.process_index
     )
-    loader = DataLoader(data, per_process_batch, sampler=sampler, transform=to_tensor)
+    if args.augment:
+        from tpudist.data.transforms import standard_cifar_augment
+
+        transform = standard_cifar_augment(seed=ctx.process_index)
+    else:
+        transform = to_tensor  # reference parity (main.py:46: ToTensor only)
+    loader = DataLoader(data, per_process_batch, sampler=sampler, transform=transform)
 
     from tpudist.optim import make_optimizer
 
@@ -150,8 +159,16 @@ def main(argv=None):
         # drop_remainder=False + evaluate's pad-and-mask scores the FULL val
         # set (the reference's loop covers every sample too); no tail drop
         eval_batch = min(per_process_batch, len(val["label"]))
+        if args.augment:
+            # eval must see the training distribution: normalized, but no
+            # crop/flip (test-time augmentation is not the standard recipe)
+            from tpudist.data.transforms import compose, normalize
+
+            eval_transform = compose(to_tensor, normalize())
+        else:
+            eval_transform = to_tensor
         val_loader = DataLoader(
-            val, eval_batch, transform=to_tensor, drop_remainder=False
+            val, eval_batch, transform=eval_transform, drop_remainder=False
         )
         acc = evaluate(model, state, val_loader, mesh)
         if ctx.process_index == 0:

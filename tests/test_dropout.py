@@ -1,0 +1,94 @@
+"""Dropout plumbing: models declare a ``dropout`` rate, the compiled train
+step derives a per-step 'dropout' rng, eval stays deterministic."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpudist import mesh as mesh_lib
+from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+from tpudist.models import vit_b16
+from tpudist.train import (
+    create_train_state, lm_loss, make_train_step, state_shardings_of,
+)
+
+
+def test_gpt2_dropout_trains_and_varies_per_step():
+    mesh = mesh_lib.create_mesh()
+    model = GPT2(
+        vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=4,
+        dropout=0.5,
+    )
+    tx = optax.sgd(0.0)  # lr 0: params frozen, loss changes only via masks
+    state = create_train_state(model, 0, jnp.zeros((8, 16), jnp.int32), tx, mesh)
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    rng = np.random.Generator(np.random.PCG64(0))
+    batch = {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    state, m1 = step(state, batch)
+    state, m2 = step(state, batch)
+    # same params, same batch, different step → different dropout mask → loss moves
+    assert float(m1["loss"]) != float(m2["loss"])
+
+
+def test_dropout_eval_is_deterministic_and_matches_no_dropout():
+    model_d = GPT2(
+        vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=4,
+        dropout=0.3,
+    )
+    model_p = GPT2(
+        vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=4,
+    )
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    variables = model_d.init(jax.random.key(0), tokens, train=False)
+    # train=False: dropout is identity — same params, same logits
+    a = model_d.apply(variables, tokens, train=False)
+    b = model_p.apply(variables, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_vit_dropout_train_step():
+    from tpudist.data.cifar import synthetic_cifar, to_tensor
+
+    mesh = mesh_lib.create_mesh()
+    model = vit_b16(
+        num_classes=10, patch_size=8, hidden_dim=32, depth=2, num_heads=4,
+        mlp_dim=64, dropout=0.1,
+    )
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
+    step = make_train_step(model, tx, mesh)
+    batch = to_tensor(synthetic_cifar(n=16, num_classes=10))
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+
+
+def test_chunked_ce_rejects_dropout():
+    with pytest.raises(ValueError):
+        chunked_lm_forward(GPT2(dropout=0.1))
+
+
+def test_grad_accum_with_dropout_runs():
+    mesh = mesh_lib.create_mesh()
+    model = GPT2(
+        vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=4,
+        dropout=0.2,
+    )
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((8, 16), jnp.int32), tx, mesh)
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+        grad_accum=2,
+    )
+    rng = np.random.Generator(np.random.PCG64(1))
+    batch = {"tokens": rng.integers(0, 64, (16, 16)).astype(np.int32)}
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,80 @@
+"""Host-side augmentation pipeline (tpudist.data.transforms)."""
+
+import numpy as np
+
+from tpudist.data.cifar import synthetic_cifar, to_tensor
+from tpudist.data.transforms import (
+    CIFAR_MEAN, CIFAR_STD, compose, normalize, random_crop_flip,
+    standard_cifar_augment,
+)
+
+
+def _batch(n=16):
+    return synthetic_cifar(n=n, num_classes=10)
+
+
+def test_crop_flip_shapes_and_dtype():
+    batch = _batch()
+    out = random_crop_flip(seed=0)(batch)
+    assert out["image"].shape == batch["image"].shape
+    assert out["image"].dtype == batch["image"].dtype  # still uint8
+    np.testing.assert_array_equal(out["label"], batch["label"])
+
+
+def test_crop_zero_pad_no_flip_is_identity():
+    batch = _batch()
+    out = random_crop_flip(pad=0, flip=False)(batch)
+    np.testing.assert_array_equal(out["image"], batch["image"])
+
+
+def test_crop_preserves_pixel_population_per_row():
+    """A crop with pad=0 shifts nothing; with flip the row pixel multiset is
+    preserved (flip only reverses)."""
+    batch = _batch(4)
+    out = random_crop_flip(pad=0, flip=True, seed=3)(batch)
+    a = np.sort(out["image"], axis=2)
+    b = np.sort(batch["image"], axis=2)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_normalize_statistics():
+    batch = to_tensor(_batch(64))
+    out = normalize()(batch)
+    want = (batch["image"] - CIFAR_MEAN) / CIFAR_STD
+    np.testing.assert_allclose(out["image"], want, rtol=1e-6)
+
+
+def test_standard_pipeline_composes():
+    batch = _batch()
+    out = standard_cifar_augment(seed=0)(batch)
+    assert out["image"].dtype == np.float32
+    assert out["image"].shape == (16, 32, 32, 3)
+    # normalized: roughly zero-centered
+    assert abs(float(out["image"].mean())) < 1.5
+
+
+def test_deterministic_given_seed():
+    a = random_crop_flip(seed=7)(_batch())
+    b = random_crop_flip(seed=7)(_batch())
+    np.testing.assert_array_equal(a["image"], b["image"])
+
+
+def test_trains_through_loader():
+    import jax.numpy as jnp
+    import optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.data.loader import DataLoader
+    from tpudist.models import resnet18
+    from tpudist.train import create_train_state, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    data = _batch(32)
+    loader = DataLoader(data, 16, transform=standard_cifar_augment(seed=0))
+    model = resnet18(num_classes=10, small_inputs=True)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
+    step = make_train_step(model, tx, mesh)
+    for batch in loader:
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,87 @@
+"""Host-side batch transforms: augmentation + normalization.
+
+The reference applies only ``ToTensor`` (/root/reference/main.py:46 —
+SURVEY.md §2a notes "no augmentation, no normalization"); ``to_tensor``
+in :mod:`tpudist.data.cifar` reproduces that default. This module adds the
+standard CIFAR training recipe as an opt-in extension: pad-reflect random
+crop + horizontal flip on uint8 (cheap on host, before the float conversion)
+then per-channel normalization after it.
+
+Transforms are ``dict -> dict`` callables over the batch (NHWC arrays) and
+compose left-to-right with :func:`compose`, matching the DataLoader's
+``transform=`` contract. Augmentation randomness is a seeded per-loader
+stream: sampler order stays the reference's deterministic permutation, and
+(like torch's DataLoader) augmentation noise is NOT replayed exactly across
+a mid-epoch checkpoint resume.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# torchvision's canonical CIFAR statistics
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def compose(*fns):
+    def run(batch):
+        for f in fns:
+            batch = f(batch)
+        return batch
+
+    return run
+
+
+def normalize(mean=CIFAR_MEAN, std=CIFAR_STD, key: str = "image"):
+    """Per-channel (x − mean)/std on float NHWC images."""
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+
+    def run(batch):
+        out = dict(batch)
+        out[key] = (np.asarray(batch[key], np.float32) - mean) / std
+        return out
+
+    return run
+
+
+def random_crop_flip(
+    pad: int = 4, flip: bool = True, seed: int = 0, key: str = "image"
+):
+    """Pad-reflect + random crop back to size, then random horizontal flip.
+
+    Operates on uint8 NHWC before ``to_tensor`` (integer moves are cheaper
+    than float). Vectorized: one gather per batch, no per-image python loop.
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+
+    def run(batch):
+        img = np.asarray(batch[key])
+        n, h, w, c = img.shape
+        padded = np.pad(
+            img, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+        )
+        ys = rng.integers(0, 2 * pad + 1, n)
+        xs = rng.integers(0, 2 * pad + 1, n)
+        rows = ys[:, None] + np.arange(h)[None, :]          # [n, h]
+        cols = xs[:, None] + np.arange(w)[None, :]          # [n, w]
+        cropped = padded[
+            np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]
+        ]
+        if flip:
+            do = rng.random(n) < 0.5
+            cropped[do] = cropped[do, :, ::-1]
+        out = dict(batch)
+        out[key] = cropped
+        return out
+
+    return run
+
+
+def standard_cifar_augment(seed: int = 0):
+    """crop(pad 4) + flip → ToTensor → normalize — the standard CIFAR
+    training pipeline (the reference's is ToTensor only)."""
+    from tpudist.data.cifar import to_tensor
+
+    return compose(random_crop_flip(seed=seed), to_tensor, normalize())

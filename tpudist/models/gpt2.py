@@ -43,11 +43,18 @@ class Block(nn.Module):
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     mesh: Any = None
+    # residual dropout (GPT-2 uses 0.1); needs a 'dropout' rng when > 0 and
+    # train=True — tpudist.train supplies a per-step key automatically
+    dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = True):
         b, s, d = x.shape
         h = self.num_heads
+        drop = lambda y: (
+            nn.Dropout(self.dropout, deterministic=not train)(y)
+            if self.dropout else y
+        )
         dense_init = nn.initializers.lecun_normal()
         partitioned = _partitioned if self.tp else (lambda init, *axes: init)
         y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
@@ -78,7 +85,7 @@ class Block(nn.Module):
             d, axis=(-2, -1), dtype=self.dtype, name="out",
             kernel_init=partitioned(dense_init, TENSOR_AXIS, None, None),
         )(attn)
-        x = x + y
+        x = x + drop(y)
         y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
         if self.num_experts > 0:
             from tpudist.parallel.ep import MoEMlp
@@ -99,7 +106,7 @@ class Block(nn.Module):
                 d, dtype=self.dtype, name="mlp_proj",
                 kernel_init=partitioned(dense_init, TENSOR_AXIS, None),
             )(y)
-        return x + y
+        return x + drop(y)
 
 
 class GPT2(nn.Module):
@@ -118,6 +125,7 @@ class GPT2(nn.Module):
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     mesh: Any = None
+    dropout: float = 0.0  # embedding + residual dropout (GPT-2 paper: 0.1)
 
     @property
     def has_aux_loss(self) -> bool:
@@ -135,14 +143,16 @@ class GPT2(nn.Module):
             "wpe", nn.initializers.normal(0.01), (self.max_seq_len, self.hidden_dim), jnp.float32
         )
         x = wte[tokens].astype(self.dtype) + wpe[:s].astype(self.dtype)
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.depth):
             moe_here = self.num_experts > 0 and (i % self.moe_every == self.moe_every - 1)
             x = Block(
                 self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
                 num_experts=self.num_experts if moe_here else 0,
                 moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
-                mesh=self.mesh, name=f"h_{i}",
-            )(x)
+                mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
+            )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head
@@ -179,6 +189,11 @@ def chunked_lm_forward(model: GPT2, chunk: int = 256):
 
     if model.num_experts:
         raise ValueError("chunked_lm_forward does not support MoE models")
+    if model.dropout:
+        raise ValueError(
+            "chunked_lm_forward does not support dropout (the fused path "
+            "has no rng stream); use the default forward"
+        )
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
 

@@ -33,19 +33,24 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
+    dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = True):
         b, s, d = x.shape
         h = self.num_heads
+        drop = lambda y: (
+            nn.Dropout(self.dropout, deterministic=not train)(y)
+            if self.dropout else y
+        )
         y = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = multi_head_attention(q, k, v, impl=self.attn_impl)
         y = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(attn)
-        x = x + y
+        x = x + drop(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        return x + MlpBlock(self.mlp_dim, dtype=self.dtype)(y)
+        return x + drop(MlpBlock(self.mlp_dim, dtype=self.dtype)(y))
 
 
 class ViT(nn.Module):
@@ -57,6 +62,7 @@ class ViT(nn.Module):
     mlp_dim: int = 3072
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
+    dropout: float = 0.0  # residual dropout; rng plumbed by tpudist.train
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -77,8 +83,9 @@ class ViT(nn.Module):
         for i in range(self.depth):
             x = EncoderBlock(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
-                attn_impl=self.attn_impl, name=f"block_{i}",
-            )(x)
+                attn_impl=self.attn_impl, dropout=self.dropout,
+                name=f"block_{i}",
+            )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
 

@@ -134,6 +134,7 @@ def make_train_step(
     state_sharding=None,
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
+    dropout_seed: int = 0,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
 
@@ -141,6 +142,10 @@ def make_train_step(
     (loss, new_stats)`` replacing the default logits+loss_fn composition —
     e.g. :func:`tpudist.models.gpt2.chunked_lm_forward`, which keeps the LM
     head's logits from ever materializing.
+
+    ``dropout_seed`` keys the per-step dropout stream for models whose
+    ``dropout`` field is > 0 (the key is folded with the step counter, so
+    masks differ every step but agree across replicas/processes).
 
     ``state_sharding``: a TrainState-shaped pytree of NamedShardings (see
     :func:`state_shardings_of`) for TP/FSDP runs where params are NOT fully
@@ -167,29 +172,44 @@ def make_train_step(
     # parallel/ep.py) declare it via ``has_aux_loss``; duck-typed models
     # without the attribute keep the plain (non-mutable) apply path
     wants_aux = bool(getattr(model, "has_aux_loss", False))
+    # models with a dropout field > 0 need a 'dropout' rng each step; the
+    # key is derived from the step counter so every step (and every process,
+    # identically — the mask must agree across replicas) draws fresh noise
+    dropout_rate = float(getattr(model, "dropout", 0.0) or 0.0)
+    dropout_base = jax.random.key(dropout_seed)
 
-    def forward(params, batch_stats, batch):
+    def forward(params, batch_stats, batch, step):
         variables = {"params": params, "batch_stats": batch_stats}
         has_stats = len(batch_stats) > 0
         inputs = batch[input_key]
         mutable = (["batch_stats"] if has_stats else []) + (
             ["losses"] if wants_aux else []
         )
+        kwargs = {}
+        if dropout_rate > 0:
+            kwargs["rngs"] = {"dropout": jax.random.fold_in(dropout_base, step)}
         if mutable:
             logits, updates = model.apply(
-                variables, inputs, train=True, mutable=mutable
+                variables, inputs, train=True, mutable=mutable, **kwargs
             )
             new_stats = updates.get("batch_stats", batch_stats)
             aux = sum(jax.tree_util.tree_leaves(updates.get("losses", {})), 0.0)
         else:
-            logits = model.apply(variables, inputs, train=True)
+            logits = model.apply(variables, inputs, train=True, **kwargs)
             new_stats = batch_stats
             aux = 0.0
         loss = loss_fn(logits, batch[label_key]) + aux
         return loss, new_stats
 
     if forward_loss is not None:
-        forward = forward_loss
+        # fused losses don't take the step arg (no dropout on that path) —
+        # refuse rather than silently train without the configured dropout
+        if dropout_rate > 0:
+            raise ValueError(
+                f"model.dropout={dropout_rate} but forward_loss has no rng "
+                "stream; use the default forward or a dropout-free model"
+            )
+        forward = lambda params, stats, batch, step: forward_loss(params, stats, batch)
     if remat:
         forward = jax.checkpoint(forward)
 
@@ -197,11 +217,17 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch):
         if grad_accum == 1:
-            (loss, new_stats), grads = grad_fn(state.params, state.batch_stats, batch)
+            (loss, new_stats), grads = grad_fn(
+                state.params, state.batch_stats, batch, state.step
+            )
         else:
-            def micro(carry, mb):
+            def micro(carry, xs):
+                mb, i = xs
                 gsum, stats, lsum = carry
-                (l, stats), g = grad_fn(state.params, stats, mb)
+                # distinct dropout stream per microbatch
+                (l, stats), g = grad_fn(
+                    state.params, stats, mb, state.step * grad_accum + i
+                )
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
                 return (gsum, stats, lsum + l), None
 
@@ -209,7 +235,9 @@ def make_train_step(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
             (gsum, new_stats, lsum), _ = jax.lax.scan(
-                micro, (zeros, state.batch_stats, jnp.zeros((), jnp.float32)), batch
+                micro,
+                (zeros, state.batch_stats, jnp.zeros((), jnp.float32)),
+                (batch, jnp.arange(grad_accum)),
             )
             grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
             loss = lsum / grad_accum
