@@ -120,7 +120,9 @@ def main(argv=None):
     if args.augment:
         from tpudist.data.transforms import standard_cifar_augment
 
-        transform = standard_cifar_augment(seed=ctx.process_index)
+        transform = standard_cifar_augment(
+            seed=ctx.process_index, dataset=args.dataset
+        )
     else:
         transform = to_tensor  # reference parity (main.py:46: ToTensor only)
     loader = DataLoader(data, per_process_batch, sampler=sampler, transform=transform)
@@ -160,11 +162,11 @@ def main(argv=None):
         # set (the reference's loop covers every sample too); no tail drop
         eval_batch = min(per_process_batch, len(val["label"]))
         if args.augment:
-            # eval must see the training distribution: normalized, but no
-            # crop/flip (test-time augmentation is not the standard recipe)
-            from tpudist.data.transforms import compose, normalize
+            # eval must see the training distribution: normalized (same
+            # stats as the train transform), but no crop/flip
+            from tpudist.data.transforms import standard_cifar_eval
 
-            eval_transform = compose(to_tensor, normalize())
+            eval_transform = standard_cifar_eval(dataset=args.dataset)
         else:
             eval_transform = to_tensor
         val_loader = DataLoader(

@@ -4,8 +4,8 @@ import numpy as np
 
 from tpudist.data.cifar import synthetic_cifar, to_tensor
 from tpudist.data.transforms import (
-    CIFAR_MEAN, CIFAR_STD, compose, normalize, random_crop_flip,
-    standard_cifar_augment,
+    CIFAR10_MEAN, CIFAR10_STD, CIFAR100_MEAN, compose, normalize,
+    random_crop_flip, standard_cifar_augment, standard_cifar_eval,
 )
 
 
@@ -40,7 +40,7 @@ def test_crop_preserves_pixel_population_per_row():
 def test_normalize_statistics():
     batch = to_tensor(_batch(64))
     out = normalize()(batch)
-    want = (batch["image"] - CIFAR_MEAN) / CIFAR_STD
+    want = (batch["image"] - CIFAR10_MEAN) / CIFAR10_STD
     np.testing.assert_allclose(out["image"], want, rtol=1e-6)
 
 
@@ -78,3 +78,14 @@ def test_trains_through_loader():
     for batch in loader:
         state, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+def test_eval_transform_matches_train_stats():
+    """standard_cifar_eval normalizes with the SAME per-dataset stats as
+    standard_cifar_augment (no crop/flip)."""
+    batch = _batch()
+    ev = standard_cifar_eval(dataset="cifar100")(batch)
+    want = (to_tensor(batch)["image"] - CIFAR100_MEAN) / np.array(
+        [0.2673, 0.2564, 0.2762], np.float32
+    )
+    np.testing.assert_allclose(ev["image"], want, rtol=1e-5)

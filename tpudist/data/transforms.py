@@ -19,9 +19,18 @@ from __future__ import annotations
 
 import numpy as np
 
-# torchvision's canonical CIFAR statistics
-CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
-CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+# torchvision's canonical per-dataset statistics
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+
+_STATS = {
+    "cifar10": (CIFAR10_MEAN, CIFAR10_STD),
+    "cifar100": (CIFAR100_MEAN, CIFAR100_STD),
+    # synthetic mimics the 100-class set (main.py --dataset synthetic)
+    "synthetic": (CIFAR100_MEAN, CIFAR100_STD),
+}
 
 
 def compose(*fns):
@@ -33,7 +42,7 @@ def compose(*fns):
     return run
 
 
-def normalize(mean=CIFAR_MEAN, std=CIFAR_STD, key: str = "image"):
+def normalize(mean=CIFAR10_MEAN, std=CIFAR10_STD, key: str = "image"):
     """Per-channel (x − mean)/std on float NHWC images."""
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
@@ -79,9 +88,21 @@ def random_crop_flip(
     return run
 
 
-def standard_cifar_augment(seed: int = 0):
+def standard_cifar_augment(seed: int = 0, dataset: str = "cifar10"):
     """crop(pad 4) + flip → ToTensor → normalize — the standard CIFAR
-    training pipeline (the reference's is ToTensor only)."""
+    training pipeline (the reference's is ToTensor only), with the named
+    dataset's normalization statistics."""
     from tpudist.data.cifar import to_tensor
 
-    return compose(random_crop_flip(seed=seed), to_tensor, normalize())
+    mean, std = _STATS[dataset]
+    return compose(random_crop_flip(seed=seed), to_tensor, normalize(mean, std))
+
+
+def standard_cifar_eval(dataset: str = "cifar10"):
+    """ToTensor → normalize with the SAME statistics as
+    :func:`standard_cifar_augment` (no crop/flip) — the matching eval-time
+    transform; keep the pair together so train/eval can't diverge."""
+    from tpudist.data.cifar import to_tensor
+
+    mean, std = _STATS[dataset]
+    return compose(to_tensor, normalize(mean, std))

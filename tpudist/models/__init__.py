@@ -6,9 +6,9 @@ from tpudist.models.resnet import (
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
 )
 from tpudist.models.vit import ViT, vit_b16
-from tpudist.models.gpt2 import GPT2, gpt2_124m
+from tpudist.models.gpt2 import GPT2, gpt2_124m, gpt2_medium, gpt2_large
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
-    "ViT", "vit_b16", "GPT2", "gpt2_124m",
+    "ViT", "vit_b16", "GPT2", "gpt2_124m", "gpt2_medium", "gpt2_large",
 ]

@@ -169,6 +169,22 @@ def gpt2_124m(**kw) -> GPT2:
     return GPT2(**kw)
 
 
+def gpt2_medium(**kw) -> GPT2:
+    """GPT-2 medium (355M): 24 layers, 1024 hidden, 16 heads."""
+    kw.setdefault("hidden_dim", 1024)
+    kw.setdefault("depth", 24)
+    kw.setdefault("num_heads", 16)
+    return GPT2(**kw)
+
+
+def gpt2_large(**kw) -> GPT2:
+    """GPT-2 large (774M): 36 layers, 1280 hidden, 20 heads."""
+    kw.setdefault("hidden_dim", 1280)
+    kw.setdefault("depth", 36)
+    kw.setdefault("num_heads", 20)
+    return GPT2(**kw)
+
+
 def chunked_lm_forward(model: GPT2, chunk: int = 256):
     """Fused next-token loss that never materializes the [B,S,V] logits.
 

@@ -3,7 +3,10 @@
 No reference counterpart exists (the reference is ResNet-only,
 /root/reference/main.py:40); this covers the "transformer grads over ICI"
 target. TPU-first: bf16 activations with fp32 params, patchify as a single
-strided conv (one big MXU matmul), attention via tpudist.ops.
+strided conv (one big MXU matmul), attention via tpudist.ops. Encoder
+kernels carry the same Megatron ``tensor``-axis partitioning metadata as
+GPT-2 (qkv/mlp-in column-parallel, out/mlp-out row-parallel) — inert on a
+``tensor=1`` mesh, GSPMD-sharded otherwise.
 """
 
 from __future__ import annotations
@@ -13,7 +16,9 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
+from tpudist.mesh import TENSOR_AXIS
 from tpudist.ops.attention import multi_head_attention
+from tpudist.parallel.tp import partitioned as _partitioned
 
 
 class MlpBlock(nn.Module):
@@ -23,9 +28,17 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         d = x.shape[-1]
-        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        dense_init = nn.initializers.lecun_normal()
+        x = nn.Dense(
+            self.mlp_dim, dtype=self.dtype,
+            kernel_init=_partitioned(dense_init, None, TENSOR_AXIS),
+            bias_init=_partitioned(nn.initializers.zeros_init(), TENSOR_AXIS),
+        )(x)
         x = nn.gelu(x)
-        return nn.Dense(d, dtype=self.dtype)(x)
+        return nn.Dense(
+            d, dtype=self.dtype,
+            kernel_init=_partitioned(dense_init, TENSOR_AXIS, None),
+        )(x)
 
 
 class EncoderBlock(nn.Module):
@@ -43,11 +56,19 @@ class EncoderBlock(nn.Module):
             nn.Dropout(self.dropout, deterministic=not train)(y)
             if self.dropout else y
         )
+        dense_init = nn.initializers.lecun_normal()
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype, name="qkv")(y)
+        qkv = nn.DenseGeneral(
+            (3, h, d // h), dtype=self.dtype, name="qkv",
+            kernel_init=_partitioned(dense_init, None, None, TENSOR_AXIS, None),
+            bias_init=_partitioned(nn.initializers.zeros_init(), None, TENSOR_AXIS, None),
+        )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = multi_head_attention(q, k, v, impl=self.attn_impl)
-        y = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(attn)
+        y = nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, name="out",
+            kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
+        )(attn)
         x = x + drop(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         return x + drop(MlpBlock(self.mlp_dim, dtype=self.dtype)(y))

@@ -71,9 +71,10 @@ def create_train_state(
     """Initialize params/opt state on the mesh.
 
     Placement follows the model's ``nn.with_partitioning`` metadata:
-    metadata-free models (ResNet, ViT — the DDP model) come out fully
-    replicated; annotated models (GPT-2's Megatron specs) come out sharded,
-    with the optimizer's params-shaped mirrors sharded to match.
+    metadata-free models (ResNet — the DDP model) come out fully
+    replicated; annotated models (GPT-2's and ViT's Megatron specs, inert
+    on a size-1 ``tensor`` axis) come out sharded, with the optimizer's
+    params-shaped mirrors sharded to match.
 
     Same seed on every process ⇒ bit-identical params — the TPU-native
     init-sync replacing DDP's rank-0 broadcast (SURVEY.md §2.5).
