@@ -167,3 +167,20 @@ def test_evaluate_scores_ragged_tail():
     acc_ragged = evaluate(model, state, ragged, mesh)
     acc_flat = evaluate(model, state, flat, mesh)
     assert abs(acc_ragged - acc_flat) < 1e-9  # identical sample set scored
+
+
+def test_verify_replicas_single_process():
+    """Checksum path runs (trivially passes) single-process; exercised for
+    real by the multi-process launcher smoke."""
+    import optax
+
+    from tpudist.distributed import verify_replicas
+    from tpudist.models import resnet18
+    from tpudist.train import create_train_state
+
+    mesh = mesh_lib.create_mesh()
+    state = create_train_state(
+        resnet18(num_classes=10, small_inputs=True), 0,
+        jnp.zeros((1, 32, 32, 3)), optax.adam(1e-3), mesh,
+    )
+    verify_replicas(state.params)  # must not raise

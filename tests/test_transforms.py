@@ -4,8 +4,8 @@ import numpy as np
 
 from tpudist.data.cifar import synthetic_cifar, to_tensor
 from tpudist.data.transforms import (
-    CIFAR10_MEAN, CIFAR10_STD, CIFAR100_MEAN, compose, normalize,
-    random_crop_flip, standard_cifar_augment, standard_cifar_eval,
+    CIFAR10_MEAN, CIFAR10_STD, CIFAR100_MEAN, CIFAR100_STD, compose,
+    normalize, random_crop_flip, standard_cifar_augment, standard_cifar_eval,
 )
 
 
@@ -85,7 +85,5 @@ def test_eval_transform_matches_train_stats():
     standard_cifar_augment (no crop/flip)."""
     batch = _batch()
     ev = standard_cifar_eval(dataset="cifar100")(batch)
-    want = (to_tensor(batch)["image"] - CIFAR100_MEAN) / np.array(
-        [0.2673, 0.2564, 0.2762], np.float32
-    )
+    want = (to_tensor(batch)["image"] - CIFAR100_MEAN) / CIFAR100_STD
     np.testing.assert_allclose(ev["image"], want, rtol=1e-5)

@@ -358,11 +358,17 @@ def fit(
         sample_in.dtype,
     )
     state = create_train_state(model, seed, init_input, tx, mesh)
+    # DDP verifies rank param consistency at wrap time (main.py:83); same
+    # check here — same seed must have produced identical params (no-op
+    # single-process)
+    from tpudist.distributed import verify_replicas
+
+    verify_replicas(state.params)
     step = make_train_step(
         model, tx, mesh,
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
-        forward_loss=forward_loss,
+        forward_loss=forward_loss, dropout_seed=seed,
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
         # here would all-gather a TP model's params on the first step
