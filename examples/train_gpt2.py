@@ -73,7 +73,7 @@ def parse_args(argv=None):
     p.add_argument("--experts", default=0, type=int, help="MoE experts (0=dense)")
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
-    p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses"])
+    p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses", "ulysses_flash"])
     p.add_argument("--no_profiler", action="store_true")
     p.add_argument("--log_dir", default=".", type=str)
     p.add_argument("--checkpoint_dir", default=None, type=str)
@@ -146,7 +146,7 @@ def main(argv=None):
         # PipelinedGPT2 builds its blocks with tp=False (shard_map manual
         # mesh), so tensor metadata would be silently inert — reject rather
         # than mislead
-        if args.experts or args.attn in ("ring", "ulysses") or args.tensor > 1:
+        if args.experts or args.attn in ("ring", "ulysses", "ulysses_flash") or args.tensor > 1:
             raise SystemExit(
                 "--pipe composes with data parallelism only (stacked blocks)"
             )

@@ -65,7 +65,7 @@ class Block(nn.Module):
             bias_init=partitioned(nn.initializers.zeros_init(), None, TENSOR_AXIS, None),
         )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attn_impl in ("ring", "ulysses"):
+        if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
             # context-parallel attention over the 'seq' mesh axis
             # (tpudist.parallel.cp); activations arrive sequence-sharded and
             # the shard_map keeps them that way — requires ``mesh``
@@ -76,8 +76,20 @@ class Block(nn.Module):
                 )
             from tpudist.parallel.cp import ring_attention, ulysses_attention
 
-            cp_fn = ring_attention if self.attn_impl == "ring" else ulysses_attention
-            attn = cp_fn(q, k, v, self.mesh, causal=True)
+            if self.attn_impl == "ring":
+                attn = ring_attention(q, k, v, self.mesh, causal=True)
+            else:
+                attn_fn = None
+                if self.attn_impl == "ulysses_flash":
+                    # full-sequence attention per head group via the Pallas
+                    # kernel — the long-context composition (all_to_all re-
+                    # shard + blockwise softmax)
+                    from tpudist.ops.flash_attention import flash_attention
+
+                    attn_fn = flash_attention
+                attn = ulysses_attention(
+                    q, k, v, self.mesh, causal=True, attn_fn=attn_fn
+                )
         else:
             attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
         # row-parallel: contraction dim sharded; GSPMD all-reduces the output

@@ -178,5 +178,8 @@ def ulysses_attention(
             attn_fn=attn_fn,
         ),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call (the flash attn_fn) can't declare varying-manual-axes
+        # on its out_shape; keep the vma safety net for the default path
+        check_vma=(attn_fn is None),
     )
     return fn(q, k, v)

@@ -58,6 +58,32 @@ def fsdp_shardings(state, mesh: Mesh, *, min_size: int = 1024):
     )
 
 
+def compose_fsdp(state, mesh: Mesh, *, min_size: int = 1024):
+    """FSDP composed with existing model-parallel shardings.
+
+    Leaves that already carry a sharding with named axes (e.g. Megatron
+    ``tensor`` specs from ``nn.with_partitioning``) keep it; every
+    still-replicated leaf gets an ``fsdp`` spec. This is the 3-D recipe
+    (dp × fsdp × tp): TP owns the transformer kernels, FSDP shards the
+    rest (embeddings, layernorms above ``min_size``) plus all the TP-less
+    optimizer mirrors.
+
+    Returns ``(placed_state, shardings)`` like :func:`shard_state`.
+    """
+    fsdp_size = mesh.shape[FSDP_AXIS]
+
+    def merge(x):
+        spec = getattr(getattr(x, "sharding", None), "spec", P())
+        if any(s is not None for s in spec):
+            return x.sharding
+        return NamedSharding(
+            mesh, fsdp_spec(np.shape(x), fsdp_size, min_size=min_size)
+        )
+
+    shardings = jax.tree_util.tree_map(merge, state)
+    return jax.device_put(state, shardings), shardings
+
+
 def shard_state(state, mesh: Mesh, *, min_size: int = 1024):
     """Re-place a (typically replicated) TrainState under FSDP shardings.
 
