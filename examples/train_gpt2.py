@@ -61,9 +61,13 @@ def parse_args(argv=None):
     p.add_argument("--num_heads", default=12, type=int)
     p.add_argument("--vocab_size", default=50257, type=int)
     p.add_argument("--seq_len", default=1024, type=int)
-    # data: a flat token file (.npy int32/uint16) or synthetic
+    # data: a flat token file (.npy, or nanoGPT-style raw .bin) or synthetic
     p.add_argument("--tokens", default=None, type=str,
-                   help="path to a 1-D token array (.npy); default synthetic")
+                   help="flat token file (.npy, or raw .bin read as "
+                   "--token_dtype); memory-mapped, never materialized")
+    p.add_argument("--token_dtype", default="uint16", type=str,
+                   help="dtype of a raw .bin token file (uint16 fits GPT-2's "
+                   "50257-entry vocab)")
     p.add_argument("--synthetic_tokens", default=2_000_000, type=int)
     # parallelism (sizes of the mesh axes; data gets the rest)
     p.add_argument("--tensor", default=1, type=int)
@@ -82,24 +86,20 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def load_tokens(args):
-    """Flat token stream → {'tokens': [N, seq_len]} windows."""
+def token_source(args):
+    """The flat token stream: a read-only memmap of ``--tokens`` (web-scale
+    corpora never materialize) or a synthetic in-memory stand-in."""
     import numpy as np
 
+    from tpudist.data.lm import load_token_stream
+
     if args.tokens:
-        flat = np.load(args.tokens, mmap_mode="r")
-        flat = np.asarray(flat, np.int32)
-        if flat.max() >= args.vocab_size:
-            raise SystemExit(
-                f"token id {flat.max()} >= vocab_size {args.vocab_size}"
-            )
-    else:
-        rng = np.random.Generator(np.random.PCG64(0))
-        flat = rng.integers(
-            0, args.vocab_size, args.synthetic_tokens
-        ).astype(np.int32)
-    n = len(flat) // args.seq_len
-    return {"tokens": flat[: n * args.seq_len].reshape(n, args.seq_len)}
+        # vocab-range checking happens per gathered batch inside
+        # TokenWindowLoader (scanning max() over a multi-billion-token
+        # memmap up front would read the whole file)
+        return load_token_stream(args.tokens, dtype=np.dtype(args.token_dtype))
+    rng = np.random.Generator(np.random.PCG64(0))
+    return rng.integers(0, args.vocab_size, args.synthetic_tokens).astype(np.int32)
 
 
 def main(argv=None):
@@ -114,8 +114,6 @@ def main(argv=None):
 
     from tpudist import init_from_env
     from tpudist import mesh as mesh_lib
-    from tpudist.data.loader import DataLoader
-    from tpudist.data.sampler import DistributedSampler
     from tpudist.models.gpt2 import GPT2, PipelinedGPT2
     from tpudist.optim import make_optimizer, warmup_cosine
     from tpudist.train import fit, lm_loss
@@ -165,18 +163,19 @@ def main(argv=None):
             num_experts=args.experts, mesh=mesh, dropout=args.dropout,
         )
 
-    data = load_tokens(args)
+    from tpudist.data.lm import TokenWindowLoader
+
     # --batch_size is per data-parallel replica (reference semantics); model-
     # parallel axes (tensor/pipe/seq/expert) don't multiply the batch
     local_replicas = max(
         mesh_lib.data_parallel_size(mesh) // ctx.process_count, 1
     )
     per_process_batch = args.batch_size * local_replicas * args.grad_accum
-    sampler = DistributedSampler(
-        len(data["tokens"]), num_replicas=ctx.process_count,
-        rank=ctx.process_index,
+    loader = TokenWindowLoader(
+        token_source(args), per_process_batch, args.seq_len,
+        vocab_size=args.vocab_size,
+        num_replicas=ctx.process_count, rank=ctx.process_index,
     )
-    loader = DataLoader(data, per_process_batch, sampler=sampler)
 
     steps_per_epoch = len(loader)
     total = args.total_steps or max(args.epochs * steps_per_epoch, 1)

@@ -42,9 +42,15 @@ def parse_args(argv=None):
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "vit_b16", "gpt2"])
     parser.add_argument("--dataset", default="cifar100",
-                        choices=["cifar10", "cifar100", "synthetic"])
-    parser.add_argument("--data_root", default="dataset", type=str)
+                        choices=["cifar10", "cifar100", "synthetic", "imagenet"])
+    parser.add_argument("--data_root", default="dataset", type=str,
+                        help="CIFAR cache dir, or for --dataset imagenet an "
+                        "image-folder tree with train/ and val/ class subdirs")
     parser.add_argument("--synthetic_size", default=2048, type=int)
+    parser.add_argument("--image_size", default=224, type=int,
+                        help="crop size for --dataset imagenet")
+    parser.add_argument("--workers", default=None, type=int,
+                        help="decode threads for --dataset imagenet")
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     parser.add_argument("--weight_decay", default=0.0, type=float,
                         help="decoupled (AdamW) weight decay, 1-D params excluded")
@@ -91,22 +97,17 @@ def main(argv=None):
     ctx = init_from_env()
     mesh = create_mesh()
 
-    # --- dataset (reference: CIFAR-100 with ToTensor only, main.py:42-51) ---
-    # note: the model head deliberately stays 1000-way regardless of the
-    # dataset's class count — the reference does not adapt it (main.py:40)
-    if args.dataset == "synthetic":
-        data = synthetic_cifar(args.synthetic_size, num_classes=100)
-    else:
-        data = load_cifar(args.data_root, dataset=args.dataset, train=True)
-
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     # reference keeps the stock 1000-way head even on CIFAR (main.py:40)
     resnets = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
                "resnet101": resnet101, "resnet152": resnet152}
+    small = args.dataset != "imagenet"  # 32x32 CIFAR vs 224x224 folder images
     if args.model in resnets:
         model = resnets[args.model](dtype=dtype)
     elif args.model == "vit_b16":
-        model = vit_b16(dtype=dtype, patch_size=4)  # 32x32 inputs -> 64 patches
+        # 4-pixel patches keep 32x32 inputs at 64 tokens; ImageNet crops use
+        # the standard 16-pixel patches
+        model = vit_b16(dtype=dtype, patch_size=4 if small else 16)
     else:
         raise SystemExit("gpt2 training uses examples/train_gpt2.py (token data)")
 
@@ -114,18 +115,41 @@ def main(argv=None):
     # this process's loader yields batch_size × local replicas, and the mesh
     # assembles the global batch of batch_size × world_size
     per_process_batch = args.batch_size * jax.local_device_count()
-    sampler = DistributedSampler(
-        len(data["label"]), num_replicas=ctx.process_count, rank=ctx.process_index
-    )
-    if args.augment:
-        from tpudist.data.transforms import standard_cifar_augment
 
-        transform = standard_cifar_augment(
-            seed=ctx.process_index, dataset=args.dataset
+    if args.dataset == "imagenet":
+        # streaming image-folder pipeline (BASELINE configs 2/3): decode-on-
+        # demand with the standard train augmentation; --augment is implied
+        from tpudist.data.imagenet import ImageFolderLoader
+
+        loader = ImageFolderLoader(
+            os.path.join(args.data_root, "train"), per_process_batch,
+            train=True, image_size=args.image_size,
+            num_replicas=ctx.process_count, rank=ctx.process_index,
+            workers=args.workers,
         )
     else:
-        transform = to_tensor  # reference parity (main.py:46: ToTensor only)
-    loader = DataLoader(data, per_process_batch, sampler=sampler, transform=transform)
+        # --- dataset (reference: CIFAR-100 + ToTensor only, main.py:42-51);
+        # the model head deliberately stays 1000-way regardless of the
+        # dataset's class count — the reference does not adapt it (main.py:40)
+        if args.dataset == "synthetic":
+            data = synthetic_cifar(args.synthetic_size, num_classes=100)
+        else:
+            data = load_cifar(args.data_root, dataset=args.dataset, train=True)
+        sampler = DistributedSampler(
+            len(data["label"]), num_replicas=ctx.process_count,
+            rank=ctx.process_index,
+        )
+        if args.augment:
+            from tpudist.data.transforms import standard_cifar_augment
+
+            transform = standard_cifar_augment(
+                seed=ctx.process_index, dataset=args.dataset
+            )
+        else:
+            transform = to_tensor  # reference parity (main.py:46: ToTensor only)
+        loader = DataLoader(
+            data, per_process_batch, sampler=sampler, transform=transform
+        )
 
     from tpudist.optim import make_optimizer
 
@@ -154,24 +178,33 @@ def main(argv=None):
         # the reference's val loader is unsharded (every rank sees the full
         # set, /root/reference/main.py:56-63); same here, and only rank 0
         # reports — matching the commented-out accuracy print (main.py:129)
-        if args.dataset == "synthetic":
-            val = synthetic_cifar(args.synthetic_size // 4 or 1, num_classes=100)
-        else:
-            val = load_cifar(args.data_root, dataset=args.dataset, train=False)
-        # drop_remainder=False + evaluate's pad-and-mask scores the FULL val
-        # set (the reference's loop covers every sample too); no tail drop
-        eval_batch = min(per_process_batch, len(val["label"]))
-        if args.augment:
-            # eval must see the training distribution: normalized (same
-            # stats as the train transform), but no crop/flip
-            from tpudist.data.transforms import standard_cifar_eval
+        if args.dataset == "imagenet":
+            from tpudist.data.imagenet import ImageFolderLoader
 
-            eval_transform = standard_cifar_eval(dataset=args.dataset)
+            val_loader = ImageFolderLoader(
+                os.path.join(args.data_root, "val"), per_process_batch,
+                train=False, image_size=args.image_size,
+                workers=args.workers, drop_remainder=False,
+            )
         else:
-            eval_transform = to_tensor
-        val_loader = DataLoader(
-            val, eval_batch, transform=eval_transform, drop_remainder=False
-        )
+            if args.dataset == "synthetic":
+                val = synthetic_cifar(args.synthetic_size // 4 or 1, num_classes=100)
+            else:
+                val = load_cifar(args.data_root, dataset=args.dataset, train=False)
+            # drop_remainder=False + evaluate's pad-and-mask scores the FULL
+            # val set (the reference's loop covers every sample too)
+            eval_batch = min(per_process_batch, len(val["label"]))
+            if args.augment:
+                # eval must see the training distribution: normalized (same
+                # stats as the train transform), but no crop/flip
+                from tpudist.data.transforms import standard_cifar_eval
+
+                eval_transform = standard_cifar_eval(dataset=args.dataset)
+            else:
+                eval_transform = to_tensor
+            val_loader = DataLoader(
+                val, eval_batch, transform=eval_transform, drop_remainder=False
+            )
         acc = evaluate(model, state, val_loader, mesh)
         if ctx.process_index == 0:
             print(f"Accuracy: {acc:.4f}")

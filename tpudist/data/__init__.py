@@ -1,4 +1,11 @@
 from tpudist.data.sampler import DistributedSampler
 from tpudist.data.loader import DataLoader
+from tpudist.data.imagenet import ImageFolderLoader
+from tpudist.data.lm import TokenWindowLoader
 
-__all__ = ["DistributedSampler", "DataLoader"]
+__all__ = [
+    "DistributedSampler",
+    "DataLoader",
+    "ImageFolderLoader",
+    "TokenWindowLoader",
+]

@@ -1,0 +1,129 @@
+"""Language-model token datasets — the input path for BASELINE.json config 5
+(GPT-2 124M on OpenWebText-scale corpora).
+
+The reference's data layer holds a decoded array in memory
+(/root/reference/main.py:42-63); a web-scale token stream (OpenWebText is
+~9B tokens) cannot be materialized per host, so this module reads windows
+lazily from a memory-mapped flat token file and gathers only the rows a
+batch needs (one fancy-index on the memmap touches only those pages).
+
+Two on-disk formats, both zero-copy:
+
+- ``.npy`` — any integer dtype, read with ``np.load(mmap_mode="r")``;
+- ``.bin`` — raw little-endian tokens (the nanoGPT/OpenWebText convention),
+  read with ``np.memmap``; dtype defaults to uint16 (GPT-2's 50257-entry
+  vocab fits).
+
+:class:`TokenWindowLoader` exposes the same iterator contract as
+:class:`tpudist.data.loader.DataLoader` (``sampler``/``__len__``/
+``iter_from``), so ``fit``/``prefetch_to_mesh``/checkpoint-resume compose
+unchanged, and the DistributedSampler gives each host a disjoint shard of
+windows (SURVEY.md §2.6 semantics over windows instead of images).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from tpudist.data.loader import SampledLoader
+from tpudist.data.sampler import DistributedSampler
+
+
+def load_token_stream(path: str | os.PathLike, dtype=None) -> np.ndarray:
+    """Open a flat token file as a read-only memmap (no materialization)."""
+    path = Path(path)
+    if path.suffix == ".npy":
+        flat = np.load(path, mmap_mode="r")
+        if flat.ndim != 1:
+            raise ValueError(f"{path}: expected a 1-D token array, got {flat.shape}")
+        return flat
+    if path.suffix == ".bin":
+        return np.memmap(path, dtype=dtype or np.uint16, mode="r")
+    raise ValueError(f"{path}: unknown token-file suffix (want .npy or .bin)")
+
+
+def encode_bytes(text: str | bytes) -> np.ndarray:
+    """Byte-level tokenization (vocab 256) — an egress-free stand-in for a
+    trained tokenizer, enough to train a real LM on any local text file."""
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    return np.frombuffer(text, np.uint8).astype(np.int32)
+
+
+class TokenWindowLoader(SampledLoader):
+    """Batches of ``seq_len`` token windows from a flat stream.
+
+    ``source`` is a path (``.npy``/``.bin``) or a 1-D array. Windows start
+    every ``stride`` tokens (default ``seq_len``: non-overlapping, each
+    token trained on once per epoch). Each window carries one extra token
+    when ``targets_in_window`` so the model's shift-by-one loss
+    (``tpudist.train.lm_loss``: predict ``tokens[1:]`` from ``tokens[:-1]``)
+    loses no positions at window boundaries.
+
+    ``vocab_size`` guards every gathered batch: an out-of-range id (wrong
+    ``--token_dtype``, tokenizer/vocab mismatch) raises instead of letting
+    XLA's embedding gather clamp it and train silently on wrong vectors —
+    the whole stream is never scanned (it's a memmap).
+
+    Yields ``{"tokens": int32 [batch, seq_len(+1 if targets_in_window)]}``.
+    """
+
+    def __init__(
+        self,
+        source,
+        batch_size: int,
+        seq_len: int,
+        *,
+        stride: int | None = None,
+        dtype=None,
+        vocab_size: int | None = None,
+        sampler: DistributedSampler | None = None,
+        num_replicas: int = 1,
+        rank: int = 0,
+        seed: int = 0,
+        shuffle: bool = True,
+        targets_in_window: bool = False,
+        drop_remainder: bool = True,
+    ):
+        if isinstance(source, (str, os.PathLike)):
+            source = load_token_stream(source, dtype=dtype)
+        self.flat = source
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.stride = stride or seq_len
+        self.window = seq_len + (1 if targets_in_window else 0)
+        self.drop_remainder = drop_remainder
+        if len(self.flat) < self.window:
+            raise ValueError(
+                f"stream of {len(self.flat)} tokens is shorter than one "
+                f"window ({self.window})"
+            )
+        n_windows = (len(self.flat) - self.window) // self.stride + 1
+        self.num_windows = n_windows
+        self.vocab_size = vocab_size
+        self.sampler = sampler or DistributedSampler(
+            n_windows, num_replicas=num_replicas, rank=rank,
+            shuffle=shuffle, seed=seed,
+        )
+
+    def gather(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        offsets = (
+            np.asarray(indices, np.int64)[:, None] * self.stride
+            + np.arange(self.window)[None, :]
+        )
+        tokens = np.asarray(self.flat[offsets], np.int32)
+        if self.vocab_size is not None and tokens.size:
+            peak = int(tokens.max())
+            if peak >= self.vocab_size or int(tokens.min()) < 0:
+                raise ValueError(
+                    f"token id {peak if peak >= self.vocab_size else int(tokens.min())} "
+                    f"outside [0, {self.vocab_size}) — wrong --token_dtype or "
+                    "tokenizer/vocab mismatch"
+                )
+        return {"tokens": tokens}
+
+    def _gather_batch(self, idx: np.ndarray, start: int) -> dict:
+        return self.gather(idx)

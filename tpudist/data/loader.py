@@ -20,7 +20,52 @@ import numpy as np
 from tpudist.data.sampler import DistributedSampler
 
 
-class DataLoader:
+class SampledLoader:
+    """The shared iterator contract of every tpudist loader.
+
+    Subclasses set ``sampler``, ``batch_size``, ``drop_remainder`` and
+    implement ``_gather_batch(indices, start)`` (``start`` = the batch's
+    position in the epoch's index stream, for position-keyed augmentation).
+    This base provides ``__len__`` / ``__iter__`` / ``iter_from`` — one
+    implementation of the drop-remainder and mid-epoch-resume math shared by
+    the array-backed, image-folder, and token-window loaders.
+    """
+
+    sampler: DistributedSampler
+    batch_size: int
+    drop_remainder: bool
+
+    def __len__(self) -> int:
+        n = self.sampler.num_samples
+        return (
+            n // self.batch_size
+            if self.drop_remainder
+            else -(-n // self.batch_size)
+        )
+
+    def _gather_batch(self, indices: np.ndarray, start: int) -> dict:
+        raise NotImplementedError
+
+    def probe(self) -> dict:
+        """A one-SAMPLE batch for shape/dtype inspection — lets ``fit`` learn
+        the element spec without gathering (for the image loader: decoding)
+        a full per-process batch that the epoch loop will re-gather anyway."""
+        return self._gather_batch(self.sampler.epoch_indices()[:1], 0)
+
+    def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int) -> Iterator[dict]:
+        """Iterate this epoch starting at batch ``start_batch`` — index-level
+        skip for mid-epoch resume (no gather/transform work for the skipped
+        batches, unlike islice over __iter__)."""
+        indices = self.sampler.epoch_indices()
+        limit = len(self) * self.batch_size if self.drop_remainder else len(indices)
+        for start in range(start_batch * self.batch_size, limit, self.batch_size):
+            yield self._gather_batch(indices[start : start + self.batch_size], start)
+
+
+class DataLoader(SampledLoader):
     """Iterates minibatches of an array-backed dataset for one epoch.
 
     ``dataset`` is a mapping of name → numpy array, all with equal leading
@@ -62,32 +107,17 @@ class DataLoader:
         # numpy path below is the always-available fallback
         self.native = native
 
-    def __len__(self) -> int:
-        n = self.sampler.num_samples
-        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+    def _gather_batch(self, idx: np.ndarray, start: int) -> dict:
+        if self.native:
+            from tpudist.data.native import native_batch
 
-    def __iter__(self) -> Iterator[dict]:
-        return self.iter_from(0)
-
-    def iter_from(self, start_batch: int) -> Iterator[dict]:
-        """Iterate this epoch starting at batch ``start_batch`` — index-level
-        skip for mid-epoch resume (no gather/transform work for the skipped
-        batches, unlike islice over __iter__)."""
-        indices = self.sampler.epoch_indices()
-        limit = len(self) * self.batch_size if self.drop_remainder else len(indices)
-        for start in range(start_batch * self.batch_size, limit, self.batch_size):
-            idx = indices[start : start + self.batch_size]
-            if self.native:
-                from tpudist.data.native import native_batch
-
-                batch = native_batch(self.dataset, idx, self.transform)
-                if batch is not None:
-                    yield batch
-                    continue
-            batch = {k: v[idx] for k, v in self.dataset.items()}
-            if self.transform is not None:
-                batch = self.transform(batch)
-            yield batch
+            batch = native_batch(self.dataset, idx, self.transform)
+            if batch is not None:
+                return batch
+        batch = {k: v[idx] for k, v in self.dataset.items()}
+        if self.transform is not None:
+            batch = self.transform(batch)
+        return batch
 
 
 def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):

@@ -348,7 +348,13 @@ def fit(
         # (the reference's per-GPU --batch_size, main.py:25)
         batch_size = train_loader.batch_size // jax.local_device_count()
 
-    sample = next(iter(train_loader))
+    # shape/dtype probe: one gathered sample where the loader supports it
+    # (a full first batch would e.g. JPEG-decode the whole thing twice)
+    sample = (
+        train_loader.probe()
+        if hasattr(train_loader, "probe")
+        else next(iter(train_loader))
+    )
     # init sample batch = the mesh's replica count, not 1: models with manual
     # (shard_map) axes — ring/Ulysses attention — refuse traces whose batch
     # doesn't divide the mesh; zeros keep init cheap and content-independent
