@@ -55,10 +55,20 @@ def parse_args(argv=None):
                    help="sequence-chunked weight-tied CE (chunk size); the "
                    "[B,S,V] logits never materialize — raises the max batch/"
                    "seq_len per chip (dense models only)")
-    # model size
+    # model family + size
+    p.add_argument("--arch", default="gpt2", choices=["gpt2", "llama"],
+                   help="decoder family: GPT-2 (learned positions, GELU MLP, "
+                   "tied head) or Llama (RoPE, RMSNorm, SwiGLU, GQA)")
     p.add_argument("--hidden_dim", default=768, type=int)
     p.add_argument("--depth", default=12, type=int)
     p.add_argument("--num_heads", default=12, type=int)
+    p.add_argument("--num_kv_heads", default=0, type=int,
+                   help="llama GQA K/V heads (0 = MHA)")
+    p.add_argument("--ffn_dim", default=0, type=int,
+                   help="llama SwiGLU width (0 = 8/3*hidden rounded to 256)")
+    p.add_argument("--rope_theta", default=10000.0, type=float)
+    p.add_argument("--tie_embeddings", action="store_true",
+                   help="llama: tie the LM head to the embedding")
     p.add_argument("--vocab_size", default=50257, type=int)
     p.add_argument("--seq_len", default=1024, type=int)
     # data: a flat token file (.npy, or nanoGPT-style raw .bin) or synthetic
@@ -150,10 +160,28 @@ def main(argv=None):
             )
         if args.dropout:
             raise SystemExit("--dropout is not supported with --pipe")
+        if args.arch != "gpt2":
+            raise SystemExit("--pipe supports the gpt2 arch only")
         model = PipelinedGPT2(
             mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
             max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
             depth=args.depth, num_heads=args.num_heads, dtype=dtype,
+        )
+    elif args.arch == "llama":
+        from tpudist.models.llama import Llama
+
+        if args.experts:
+            raise SystemExit("--experts supports the gpt2 arch only")
+        if args.dropout:
+            raise SystemExit("llama has no dropout (matching the family)")
+        model = Llama(
+            vocab_size=args.vocab_size, max_seq_len=args.seq_len,
+            hidden_dim=args.hidden_dim, depth=args.depth,
+            num_heads=args.num_heads,
+            num_kv_heads=args.num_kv_heads or None,
+            ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
+            tie_embeddings=args.tie_embeddings,
+            dtype=dtype, attn_impl=args.attn, mesh=mesh,
         )
     else:
         model = GPT2(

@@ -185,6 +185,9 @@ def main(argv=None):
                 os.path.join(args.data_root, "val"), per_process_batch,
                 train=False, image_size=args.image_size,
                 workers=args.workers, drop_remainder=False,
+                # train's class list keys the labels: a val tree missing a
+                # class dir can't silently shift every later label
+                classes=loader.classes,
             )
         else:
             if args.dataset == "synthetic":

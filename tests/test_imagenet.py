@@ -44,6 +44,25 @@ def test_scan_sorted_classes_and_labels(folder):
     assert paths == sorted(paths)
 
 
+def test_val_labels_keyed_by_train_classes(folder, tmp_path):
+    """A val tree missing a class dir must not shift later labels: labels
+    are positions in the TRAIN class list when it's passed in."""
+    val = tmp_path / "val"
+    for cls in ["cat", "eel"]:  # no "dog" — partial download
+        (val / cls).mkdir(parents=True)
+        arr = np.zeros((40, 40, 3), np.uint8)
+        Image.fromarray(arr).save(val / cls / "x.jpg")
+    train_classes = ["cat", "dog", "eel"]
+    _, labels, classes = scan_image_folder(val, train_classes)
+    assert classes == train_classes
+    assert sorted(labels.tolist()) == [0, 2]  # eel keeps index 2
+    # a val-only class not present in train raises instead of guessing
+    (val / "zzz").mkdir()
+    Image.fromarray(arr).save(val / "zzz" / "x.jpg")
+    with pytest.raises(ValueError, match="not in the reference class list"):
+        scan_image_folder(val, train_classes)
+
+
 def test_scan_missing_root_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         scan_image_folder(tmp_path / "nope")

@@ -31,17 +31,20 @@ from pathlib import Path
 
 import numpy as np
 
+from tpudist.data.cifar import to_tensor
 from tpudist.data.loader import SampledLoader
 from tpudist.data.sampler import DistributedSampler
-
-# canonical ImageNet per-channel statistics (on [0,1] floats)
-IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
-IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+from tpudist.data.transforms import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    compose,
+    normalize as normalize_transform,
+)
 
 _EXTENSIONS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
 
 
-def scan_image_folder(root: str | os.PathLike):
+def scan_image_folder(root: str | os.PathLike, classes: list[str] | None = None):
     """``root/<class>/<image>`` → (paths, labels, class_names).
 
     Classes are the sorted subdirectory names, label = class position —
@@ -49,25 +52,40 @@ def scan_image_folder(root: str | os.PathLike):
     works unchanged. Files within a class are sorted for a deterministic
     index space (the DistributedSampler permutes *indices*, so every process
     must agree on the index → file mapping).
+
+    Pass the TRAIN split's ``classes`` when scanning a val split: labels are
+    then positions in that list, so a val tree missing a class directory
+    (partial download) cannot silently shift every later label — an unknown
+    class raises instead.
     """
     root = Path(root)
     if not root.is_dir():
         raise FileNotFoundError(f"image folder root {root} does not exist")
-    classes = sorted(d.name for d in root.iterdir() if d.is_dir())
-    if not classes:
+    found = sorted(d.name for d in root.iterdir() if d.is_dir())
+    if not found:
         raise ValueError(f"{root} has no class subdirectories")
+    if classes is None:
+        classes = found
+    else:
+        unknown = set(found) - set(classes)
+        if unknown:
+            raise ValueError(
+                f"{root} has class dirs not in the reference class list "
+                f"(train split): {sorted(unknown)[:5]}"
+            )
+    index = {cls: i for i, cls in enumerate(classes)}
     paths: list[str] = []
     labels: list[int] = []
-    for idx, cls in enumerate(classes):
+    for cls in found:
         files = sorted(
             p for p in (root / cls).iterdir()
             if p.suffix.lower() in _EXTENSIONS
         )
         paths.extend(str(p) for p in files)
-        labels.extend([idx] * len(files))
+        labels.extend([index[cls]] * len(files))
     if not paths:
         raise ValueError(f"{root} has no images under its class directories")
-    return paths, np.asarray(labels, np.int32), classes
+    return paths, np.asarray(labels, np.int32), list(classes)
 
 
 def _random_resized_crop(img, size: int, rng: np.random.Generator,
@@ -115,13 +133,6 @@ def _resize_center_crop(img, size: int):
     return img.crop((x, y, x + size, y + size))
 
 
-def normalize_images(batch: dict, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> dict:
-    """uint8 NHWC → float32, (x/255 − mean)/std per channel."""
-    out = dict(batch)
-    out["image"] = (
-        np.asarray(batch["image"], np.float32) / 255.0 - mean
-    ) / std
-    return out
 
 
 class ImageFolderLoader(SampledLoader):
@@ -152,14 +163,23 @@ class ImageFolderLoader(SampledLoader):
         seed: int = 0,
         drop_remainder: bool = True,
         normalize: bool = True,
+        classes: list[str] | None = None,
     ):
-        self.paths, self.labels, self.classes = scan_image_folder(root)
+        # val loaders pass the train loader's .classes so the two splits
+        # can never disagree on the label ↔ class-name mapping
+        self.paths, self.labels, self.classes = scan_image_folder(root, classes)
         self.batch_size = batch_size
         self.train = train
         self.image_size = image_size
         self.seed = seed
         self.drop_remainder = drop_remainder
-        self.normalize = normalize
+        # the standard stack from tpudist.data.transforms (one home for the
+        # normalization math + statistics): uint8 → [0,1] → (x−mean)/std
+        self._transform = (
+            compose(to_tensor, normalize_transform(IMAGENET_MEAN, IMAGENET_STD))
+            if normalize
+            else None
+        )
         # the sampler needs the scanned dataset size, so the loader builds
         # its own per-host shard from (num_replicas, rank) unless given one
         self.sampler = sampler or DistributedSampler(
@@ -214,7 +234,7 @@ class ImageFolderLoader(SampledLoader):
             )
         )
         batch = {"image": np.stack(images), "label": self.labels[idx]}
-        return normalize_images(batch) if self.normalize else batch
+        return self._transform(batch) if self._transform else batch
 
 
 def synthetic_imagenet(

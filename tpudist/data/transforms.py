@@ -24,12 +24,15 @@ CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
 CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 _STATS = {
     "cifar10": (CIFAR10_MEAN, CIFAR10_STD),
     "cifar100": (CIFAR100_MEAN, CIFAR100_STD),
     # synthetic mimics the 100-class set (main.py --dataset synthetic)
     "synthetic": (CIFAR100_MEAN, CIFAR100_STD),
+    "imagenet": (IMAGENET_MEAN, IMAGENET_STD),
 }
 
 

@@ -197,69 +197,8 @@ def gpt2_large(**kw) -> GPT2:
     return GPT2(**kw)
 
 
-def chunked_lm_forward(model: GPT2, chunk: int = 256):
-    """Fused next-token loss that never materializes the [B,S,V] logits.
-
-    The plain path's fp32 logits are the HBM high-water mark at realistic
-    shapes (B=32, S=1024, V=50257 → 6.6 GB) and cap the per-chip batch.
-    This forward runs the blocks once, then ``lax.scan``s the weight-tied
-    head + softmax-CE over sequence chunks with ``jax.checkpoint`` on the
-    body, so live logits are bounded by [B, chunk, V] in both passes (the
-    backward recomputes each chunk's logits instead of storing them).
-
-    Returns a ``forward_loss`` for :func:`tpudist.train.make_train_step`:
-    ``(params, batch_stats, batch) -> (loss, batch_stats)``. Mean CE over
-    all positions — identical math to ``lm_loss`` on full logits.
-    MoE models are not supported here (their sowed aux losses need the
-    default forward); use the plain path for ``num_experts > 0``.
-    """
-    import optax
-
-    if model.num_experts:
-        raise ValueError("chunked_lm_forward does not support MoE models")
-    if model.dropout:
-        raise ValueError(
-            "chunked_lm_forward does not support dropout (the fused path "
-            "has no rng stream); use the default forward"
-        )
-    if chunk < 1:
-        raise ValueError(f"chunk must be >= 1, got {chunk}")
-
-    def forward_loss(params, batch_stats, batch):
-        tokens = batch["tokens"]
-        hidden = model.apply(
-            {"params": params}, tokens, train=True, return_hidden=True
-        )
-        # params straight from model.init still carry Partitioned boxes;
-        # train-state params are already unboxed — accept both
-        wte = nn.meta.unbox(params["wte"])
-        h = hidden[:, :-1]
-        targets = tokens[:, 1:]
-        b, s, d = h.shape
-        pad = -s % chunk
-        if pad:
-            h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-            targets = jnp.pad(targets, ((0, 0), (0, pad)))
-        valid = (jnp.arange(s + pad) < s)[None, :]
-        nc = (s + pad) // chunk
-        hs = h.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)
-        ts = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
-        ms = jnp.broadcast_to(valid, (b, s + pad)).reshape(b, nc, chunk).transpose(1, 0, 2)
-
-        @jax.checkpoint
-        def body(carry, xs):
-            hc, tc, mc = xs
-            logits = jnp.einsum(
-                "bcd,vd->bcv", hc, wte.astype(hc.dtype),
-                preferred_element_type=jnp.float32,
-            )
-            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
-            return carry + jnp.sum(ce * mc), None
-
-        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
-        return total / (b * s), batch_stats
-
-    return forward_loss
+# family-neutral home; re-exported here for the established import path
+from tpudist.models.lm_utils import chunked_lm_forward  # noqa: E402,F401
 
 
 class PipelinedGPT2:

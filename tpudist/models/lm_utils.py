@@ -1,0 +1,87 @@
+"""Family-neutral LM utilities shared by the decoder models (GPT-2, Llama).
+
+No reference counterpart (the reference's model is a CNN,
+/root/reference/main.py:40); these serve the LM leg of the BASELINE ladder
+for any model exposing the ``return_hidden`` contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def lm_head_weight(params):
+    """The [V, D] output-projection weight of an LM, whichever family:
+    GPT-2's tied ``wte``, Llama's untied ``lm_head`` (falling back to its
+    ``embed`` when tied). Accepts boxed (fresh ``model.init``) and unboxed
+    (train-state) params."""
+    for key in ("lm_head", "wte", "embed"):
+        if key in params:
+            return nn.meta.unbox(params[key])
+    raise ValueError(f"no LM head weight among params: {list(params)}")
+
+
+def chunked_lm_forward(model, chunk: int = 256):
+    """Fused next-token loss that never materializes the [B,S,V] logits.
+
+    The plain path's fp32 logits are the HBM high-water mark at realistic
+    shapes (B=32, S=1024, V=50257 → 6.6 GB) and cap the per-chip batch.
+    This forward runs the blocks once, then ``lax.scan``s the weight-tied
+    head + softmax-CE over sequence chunks with ``jax.checkpoint`` on the
+    body, so live logits are bounded by [B, chunk, V] in both passes (the
+    backward recomputes each chunk's logits instead of storing them).
+
+    Works for any model with the ``return_hidden`` contract (GPT-2, Llama).
+    Returns a ``forward_loss`` for :func:`tpudist.train.make_train_step`:
+    ``(params, batch_stats, batch) -> (loss, batch_stats)``. Mean CE over
+    all positions — identical math to ``lm_loss`` on full logits.
+    MoE models are not supported here (their sowed aux losses need the
+    default forward); use the plain path for ``num_experts > 0``.
+    """
+    import optax
+
+    if getattr(model, "num_experts", 0):
+        raise ValueError("chunked_lm_forward does not support MoE models")
+    if getattr(model, "dropout", 0.0):
+        raise ValueError(
+            "chunked_lm_forward does not support dropout (the fused path "
+            "has no rng stream); use the default forward"
+        )
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+
+    def forward_loss(params, batch_stats, batch):
+        tokens = batch["tokens"]
+        hidden = model.apply(
+            {"params": params}, tokens, train=True, return_hidden=True
+        )
+        wte = lm_head_weight(params)
+        h = hidden[:, :-1]
+        targets = tokens[:, 1:]
+        b, s, d = h.shape
+        pad = -s % chunk
+        if pad:
+            h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+            targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        valid = (jnp.arange(s + pad) < s)[None, :]
+        nc = (s + pad) // chunk
+        hs = h.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)
+        ts = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
+        ms = jnp.broadcast_to(valid, (b, s + pad)).reshape(b, nc, chunk).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def body(carry, xs):
+            hc, tc, mc = xs
+            logits = jnp.einsum(
+                "bcd,vd->bcv", hc, wte.astype(hc.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+            return carry + jnp.sum(ce * mc), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
+        return total / (b * s), batch_stats
+
+    return forward_loss
