@@ -43,6 +43,8 @@ def parse_args(argv=None):
     p.add_argument("--warmup_steps", default=100, type=int)
     p.add_argument("--total_steps", default=0, type=int,
                    help="schedule horizon; 0 = epochs x steps_per_epoch")
+    p.add_argument("--optimizer", default="adam",
+                   choices=["adam", "sgd", "lamb", "lion"])
     p.add_argument("--weight_decay", default=0.1, type=float)
     p.add_argument("--clip_norm", default=1.0, type=float)
     p.add_argument("--grad_accum", default=1, type=int)
@@ -88,6 +90,11 @@ def parse_args(argv=None):
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
     p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses", "ulysses_flash"])
+    p.add_argument("--eval", action="store_true",
+                   help="after training, report next-token loss + perplexity "
+                   "over --val_tokens (or the training stream if unset)")
+    p.add_argument("--val_tokens", default=None, type=str,
+                   help="held-out token file (.npy/.bin) for --eval")
     p.add_argument("--no_profiler", action="store_true")
     p.add_argument("--log_dir", default=".", type=str)
     p.add_argument("--checkpoint_dir", default=None, type=str)
@@ -210,6 +217,7 @@ def main(argv=None):
     tx = make_optimizer(
         warmup_cosine(args.lr, warmup_steps=min(args.warmup_steps, total // 2),
                       total_steps=total),
+        optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
 
@@ -261,6 +269,45 @@ def main(argv=None):
             f"tokens/sec: {seqs * args.seq_len / wall:.1f} "
             f"(global, incl. compile) steps={n_steps} final_loss={losses[-1]:.4f}"
         )
+
+    if args.eval:
+        from tpudist.train import evaluate_lm
+
+        if args.cp > 1 or args.pipe > 1:
+            # cp: eval uses the plain forward; pipe: pipeline_apply needs
+            # batches padded to num_micro, which evaluate_lm doesn't do
+            raise SystemExit(
+                "--eval supports the non-cp, non-pipe paths; rerun eval "
+                "separately without --cp/--pipe"
+            )
+        # held-out stream if provided; otherwise the training stream in
+        # order (smoke-level perplexity, like the reference's val loader
+        # being the train-distribution set, /root/reference/main.py:56-63)
+        if args.val_tokens:
+            import numpy as np
+
+            from tpudist.data.lm import load_token_stream
+
+            source = load_token_stream(
+                args.val_tokens, dtype=np.dtype(args.token_dtype)
+            )
+        else:
+            source = token_source(args)
+
+        val_loader = TokenWindowLoader(
+            source, args.batch_size * local_replicas, args.seq_len,
+            vocab_size=args.vocab_size, shuffle=False, drop_remainder=False,
+        )
+        # same chunked head as training: without it, --eval would re-create
+        # the [B,S,V] logits peak that --chunked_ce exists to avoid
+        metrics = evaluate_lm(
+            model, state, val_loader, mesh, chunk=args.chunked_ce or None
+        )
+        if ctx.process_index == 0:
+            print(
+                f"val_loss: {metrics['loss']:.4f} "
+                f"perplexity: {metrics['perplexity']:.2f}"
+            )
     return state, losses
 
 

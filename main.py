@@ -52,6 +52,9 @@ def parse_args(argv=None):
     parser.add_argument("--workers", default=None, type=int,
                         help="decode threads for --dataset imagenet")
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    parser.add_argument("--optimizer", default="adam",
+                        choices=["adam", "sgd", "lamb", "lion"],
+                        help="reference default: Adam(lr=1e-3), main.py:80")
     parser.add_argument("--weight_decay", default=0.0, type=float,
                         help="decoupled (AdamW) weight decay, 1-D params excluded")
     parser.add_argument("--clip_norm", default=None, type=float,
@@ -155,7 +158,8 @@ def main(argv=None):
 
     # defaults reproduce the reference's Adam(lr=1e-3) (main.py:80) exactly
     tx = make_optimizer(
-        args.lr, weight_decay=args.weight_decay, clip_norm=args.clip_norm
+        args.lr, optimizer=args.optimizer,
+        weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
     state, losses = fit(
         model, tx, loader,

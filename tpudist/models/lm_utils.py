@@ -23,6 +23,46 @@ def lm_head_weight(params):
     raise ValueError(f"no LM head weight among params: {list(params)}")
 
 
+def chunked_ce_sum(head_w, h, targets, pos_mask, chunk: int):
+    """Sum of softmax-CE over masked positions, scanning the LM head over
+    sequence chunks so live logits are bounded by [B, chunk, V] in forward
+    AND backward (``jax.checkpoint`` recomputes each chunk's logits).
+
+    ``h``: [B, S, D] hidden states; ``targets``/``pos_mask``: [B, S].
+    The one home for the chunked-head math — both the training loss
+    (:func:`chunked_lm_forward`) and eval (:func:`tpudist.train.evaluate_lm`)
+    ride it, so HBM behavior can't diverge between the two.
+    """
+    import optax
+
+    b, s, d = h.shape
+    pad = -s % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    pos_mask = jnp.pad(
+        jnp.broadcast_to(pos_mask, (b, s)).astype(jnp.float32),
+        ((0, 0), (0, pad)),
+    )
+    nc = (s + pad) // chunk
+    hs = h.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)
+    ts = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
+    ms = pos_mask.reshape(b, nc, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        hc, tc, mc = xs
+        logits = jnp.einsum(
+            "bcd,vd->bcv", hc, head_w.astype(hc.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return carry + jnp.sum(ce * mc), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
+    return total
+
+
 def chunked_lm_forward(model, chunk: int = 256):
     """Fused next-token loss that never materializes the [B,S,V] logits.
 
@@ -40,8 +80,6 @@ def chunked_lm_forward(model, chunk: int = 256):
     MoE models are not supported here (their sowed aux losses need the
     default forward); use the plain path for ``num_experts > 0``.
     """
-    import optax
-
     if getattr(model, "num_experts", 0):
         raise ValueError("chunked_lm_forward does not support MoE models")
     if getattr(model, "dropout", 0.0):
@@ -57,31 +95,12 @@ def chunked_lm_forward(model, chunk: int = 256):
         hidden = model.apply(
             {"params": params}, tokens, train=True, return_hidden=True
         )
-        wte = lm_head_weight(params)
         h = hidden[:, :-1]
         targets = tokens[:, 1:]
-        b, s, d = h.shape
-        pad = -s % chunk
-        if pad:
-            h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-            targets = jnp.pad(targets, ((0, 0), (0, pad)))
-        valid = (jnp.arange(s + pad) < s)[None, :]
-        nc = (s + pad) // chunk
-        hs = h.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)
-        ts = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
-        ms = jnp.broadcast_to(valid, (b, s + pad)).reshape(b, nc, chunk).transpose(1, 0, 2)
-
-        @jax.checkpoint
-        def body(carry, xs):
-            hc, tc, mc = xs
-            logits = jnp.einsum(
-                "bcd,vd->bcv", hc, wte.astype(hc.dtype),
-                preferred_element_type=jnp.float32,
-            )
-            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
-            return carry + jnp.sum(ce * mc), None
-
-        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
+        b, s, _ = h.shape
+        total = chunked_ce_sum(
+            lm_head_weight(params), h, targets, jnp.ones((b, s)), chunk
+        )
         return total / (b * s), batch_stats
 
     return forward_loss

@@ -77,6 +77,19 @@ def make_optimizer(
         parts.append(optax.sgd(lr, momentum=b1))
         if weight_decay > 0.0:
             parts.insert(-1, optax.add_decayed_weights(weight_decay, decay_mask))
+    elif optimizer == "lamb":
+        # layerwise-adaptive Adam — the large-batch (32k+) training optimizer
+        parts.append(
+            optax.lamb(lr, b1=b1, b2=b2, eps=eps,
+                       weight_decay=weight_decay, mask=decay_mask)
+        )
+    elif optimizer == "lion":
+        # sign-momentum; half the optimizer HBM of Adam (one moment, and it
+        # tolerates bf16) — useful when the Adam mirrors dominate memory
+        parts.append(
+            optax.lion(lr, b1=b1, b2=0.99 if b2 == 0.999 else b2,
+                       weight_decay=weight_decay, mask=decay_mask)
+        )
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
     tx = optax.chain(*parts) if len(parts) > 1 else parts[0]

@@ -484,29 +484,15 @@ def fit(
     return state, losses
 
 
-def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
-             *, input_key: str = "image", label_key: str = "label") -> float:
-    """Top-1 accuracy over a loader — the reference's dormant eval pass
-    (/root/reference/main.py:119-130), alive and tested here.
-
-    Scores EVERY sample: a final batch that doesn't divide the mesh's
-    replica count is padded (repeating the last row) and the padding is
-    masked out of the correct-count, so no val tail is silently dropped.
-    """
-    mesh = mesh or mesh_lib.create_mesh()
+def _padded_batches(loader, mesh: Mesh, key: str):
+    """Yield ``(staged_batch, staged_row_mask, n_real_rows)`` with every
+    batch padded (repeating the last row) to the mesh's replica count and
+    the padding masked — the one home for the ragged-final-batch math that
+    both eval paths (:func:`evaluate`, :func:`evaluate_lm`) share."""
     dp = mesh_lib.data_parallel_size(mesh)
-
-    @jax.jit
-    def count_correct(params, batch_stats, batch, mask):
-        variables = {"params": params, "batch_stats": batch_stats}
-        logits = model.apply(variables, batch[input_key], train=False)
-        hit = jnp.argmax(logits, axis=-1) == batch[label_key]
-        return jnp.sum(jnp.where(mask, hit, False))
-
-    cnt, total = 0, 0
     for batch in loader:
         batch = {k: np.asarray(v) for k, v in batch.items()}
-        n = batch[label_key].shape[0]
+        n = batch[key].shape[0]
         pad = -n % dp
         if pad:
             batch = {
@@ -518,6 +504,86 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
         mask = mesh_lib.put_sharded(
             mask, mesh_lib.batch_sharding(mesh, extra_dims=0)
         )
+        yield batch, mask, n
+
+
+def evaluate_lm(
+    model, state: TrainState, loader, mesh: Mesh | None = None,
+    *, input_key: str = "tokens", chunk: int | None = None,
+) -> dict[str, float]:
+    """Next-token CE and perplexity over a token-window loader — the LM
+    counterpart of :func:`evaluate` (the reference's eval loop is
+    classification-only and dormant, /root/reference/main.py:119-130).
+
+    Scores EVERY window: a ragged final batch is padded to the mesh's
+    replica count and masked out of both numerator and denominator.
+    ``chunk`` scans the LM head over sequence chunks
+    (:func:`tpudist.models.lm_utils.chunked_ce_sum`) so the [B,S,V] fp32
+    logits never materialize — pass it whenever training needed
+    ``chunked_lm_forward`` for the same reason, or eval will re-create the
+    very HBM peak the training path avoided.
+    Returns ``{"loss": mean per-token CE, "perplexity": exp(loss)}``.
+    """
+    import math
+
+    mesh = mesh or mesh_lib.create_mesh()
+
+    if chunk:
+        from tpudist.models.lm_utils import chunked_ce_sum, lm_head_weight
+
+        @jax.jit
+        def batch_ce(params, batch, mask):
+            tokens = batch[input_key]
+            hidden = model.apply(
+                {"params": params}, tokens, train=False, return_hidden=True
+            )
+            b, s = tokens.shape
+            return chunked_ce_sum(
+                lm_head_weight(params), hidden[:, :-1], tokens[:, 1:],
+                mask[:, None] * jnp.ones((b, s - 1)), chunk,
+            )
+    else:
+
+        @jax.jit
+        def batch_ce(params, batch, mask):
+            tokens = batch[input_key]
+            logits = model.apply({"params": params}, tokens, train=False)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]
+            )
+            return jnp.sum(jnp.where(mask[:, None], ce, 0.0))
+
+    total, positions = 0.0, 0
+    for batch, mask, n in _padded_batches(loader, mesh, input_key):
+        s = batch[input_key].shape[1]
+        total += float(batch_ce(state.params, batch, mask))
+        # multi-process: every process contributes its batch copy as a shard
+        # (same accounting as evaluate())
+        positions += n * (s - 1) * jax.process_count()
+    loss = total / max(positions, 1)
+    return {"loss": loss, "perplexity": math.exp(min(loss, 30.0))}
+
+
+def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
+             *, input_key: str = "image", label_key: str = "label") -> float:
+    """Top-1 accuracy over a loader — the reference's dormant eval pass
+    (/root/reference/main.py:119-130), alive and tested here.
+
+    Scores EVERY sample: a final batch that doesn't divide the mesh's
+    replica count is padded (repeating the last row) and the padding is
+    masked out of the correct-count, so no val tail is silently dropped.
+    """
+    mesh = mesh or mesh_lib.create_mesh()
+
+    @jax.jit
+    def count_correct(params, batch_stats, batch, mask):
+        variables = {"params": params, "batch_stats": batch_stats}
+        logits = model.apply(variables, batch[input_key], train=False)
+        hit = jnp.argmax(logits, axis=-1) == batch[label_key]
+        return jnp.sum(jnp.where(mask, hit, False))
+
+    cnt, total = 0, 0
+    for batch, mask, n in _padded_batches(loader, mesh, label_key):
         cnt += int(count_correct(state.params, state.batch_stats, batch, mask))
         # multi-process: every process contributes its batch copy as a shard,
         # so the summed hit-count is over process_count × n rows — the
