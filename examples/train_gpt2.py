@@ -135,6 +135,15 @@ def main(argv=None):
     from tpudist.optim import make_optimizer, warmup_cosine
     from tpudist.train import fit, lm_loss
 
+    if args.eval and (args.cp > 1 or args.pipe > 1):
+        # fail fast, BEFORE the (possibly hours-long) training run: cp eval
+        # would need the plain forward, pipe eval batches padded to
+        # num_micro — neither is what evaluate_lm does
+        raise SystemExit(
+            "--eval supports the non-cp, non-pipe paths; rerun eval "
+            "separately without --cp/--pipe"
+        )
+
     ctx = init_from_env()
     n_dev = jax.device_count()
     if args.expert_axis:
@@ -272,14 +281,6 @@ def main(argv=None):
 
     if args.eval:
         from tpudist.train import evaluate_lm
-
-        if args.cp > 1 or args.pipe > 1:
-            # cp: eval uses the plain forward; pipe: pipeline_apply needs
-            # batches padded to num_micro, which evaluate_lm doesn't do
-            raise SystemExit(
-                "--eval supports the non-cp, non-pipe paths; rerun eval "
-                "separately without --cp/--pipe"
-            )
         # held-out stream if provided; otherwise the training stream in
         # order (smoke-level perplexity, like the reference's val loader
         # being the train-distribution set, /root/reference/main.py:56-63)
@@ -294,9 +295,13 @@ def main(argv=None):
         else:
             source = token_source(args)
 
+        # sharded like the train loader so N hosts split the eval work
+        # instead of each scoring the full set (the sampler's pad-to-
+        # divisible may re-count at most process_count-1 head windows)
         val_loader = TokenWindowLoader(
             source, args.batch_size * local_replicas, args.seq_len,
             vocab_size=args.vocab_size, shuffle=False, drop_remainder=False,
+            num_replicas=ctx.process_count, rank=ctx.process_index,
         )
         # same chunked head as training: without it, --eval would re-create
         # the [B,S,V] logits peak that --chunked_ce exists to avoid

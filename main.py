@@ -52,6 +52,10 @@ def parse_args(argv=None):
     parser.add_argument("--workers", default=None, type=int,
                         help="decode threads for --dataset imagenet")
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    parser.add_argument("--stem", default="conv7",
+                        choices=["conv7", "space_to_depth"],
+                        help="ResNet stem; space_to_depth is the MLPerf TPU "
+                        "stem (same function class, ~2.5%% faster on v5e)")
     parser.add_argument("--optimizer", default="adam",
                         choices=["adam", "sgd", "lamb", "lion"],
                         help="reference default: Adam(lr=1e-3), main.py:80")
@@ -106,7 +110,7 @@ def main(argv=None):
                "resnet101": resnet101, "resnet152": resnet152}
     small = args.dataset != "imagenet"  # 32x32 CIFAR vs 224x224 folder images
     if args.model in resnets:
-        model = resnets[args.model](dtype=dtype)
+        model = resnets[args.model](dtype=dtype, stem=args.stem)
     elif args.model == "vit_b16":
         # 4-pixel patches keep 32x32 inputs at 64 tokens; ImageNet crops use
         # the standard 16-pixel patches

@@ -88,6 +88,8 @@ def test_optimizer_factory_variants():
 
     params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
     grads = {"w": jnp.full((4, 4), 0.1), "b": jnp.full((4,), 0.1)}
+    n_param_leaves = len(jax.tree_util.tree_leaves(params))
+    moments = {}
     for name in ("adam", "sgd", "lamb", "lion"):
         tx = make_optimizer(1e-3, optimizer=name, weight_decay=0.01,
                             clip_norm=1.0)
@@ -97,3 +99,10 @@ def test_optimizer_factory_variants():
             np.isfinite(np.asarray(u)).all()
             for u in jax.tree_util.tree_leaves(updates)
         ), name
+        # params-shaped moment tensors in the optimizer state
+        moments[name] = sum(
+            1 for leaf in jax.tree_util.tree_leaves(opt_state)
+            if getattr(leaf, "shape", None) in ((4, 4), (4,))
+        ) // n_param_leaves
+    assert moments["adam"] == 2  # mu + nu
+    assert moments["lion"] == 1  # the memory advantage the docstring claims

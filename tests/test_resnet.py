@@ -17,3 +17,43 @@ def test_resnet_variant_factories():
     variables = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
     logits = m.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
     assert logits.shape == (2, 10)
+
+
+def test_space_to_depth_stem():
+    """The MLPerf stem keeps the stage geometry of conv7 (same feature-map
+    sizes into stage 1) and trains; odd input sizes are rejected."""
+    import numpy as np
+    import pytest
+
+    from tpudist.models import resnet18
+
+    for stem in ("conv7", "space_to_depth"):
+        m = resnet18(num_classes=10, stem=stem)
+        v = m.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+        logits = m.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
+        assert logits.shape == (2, 10), stem
+    s2d = resnet18(num_classes=10, stem="space_to_depth")
+    with pytest.raises(ValueError, match="even H/W"):
+        s2d.init(jax.random.key(0), jnp.zeros((1, 63, 63, 3)), train=False)
+    with pytest.raises(ValueError, match="unknown stem"):
+        resnet18(stem="wat").init(
+            jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False
+        )
+    # the s2d stem kernel sees 4x the input channels
+    k = s2d.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    assert k["params"]["conv_init_s2d"]["kernel"].shape == (4, 4, 12, 64)
+    # and it trains: one SGD step moves the loss
+    import optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.train import create_train_state, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    tx = optax.sgd(0.1)
+    state = create_train_state(s2d, 0, jnp.zeros((1, 64, 64, 3)), tx, mesh)
+    step = make_train_step(s2d, tx, mesh)
+    rng = np.random.Generator(np.random.PCG64(0))
+    batch = {"image": rng.random((8, 64, 64, 3), np.float32),
+             "label": rng.integers(0, 10, 8).astype(np.int32)}
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

@@ -86,6 +86,12 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     axis_name: str | None = None
     small_inputs: bool = False  # CIFAR stem: 3x3/s1 conv, no maxpool
+    # "conv7" = torchvision's 7x7/s2 stem (parity default). "space_to_depth"
+    # = the MLPerf TPU stem: 2x2 space-to-depth on the image then a 4x4/s1
+    # conv — same function class (bijective reparametrization of a padded
+    # 8x8/s2 conv) but the MXU sees 12 input channels instead of 3, which
+    # the 128-lane systolic array tiles far better
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -103,8 +109,17 @@ class ResNet(nn.Module):
         x = jnp.asarray(x, self.dtype)
         if self.small_inputs:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
-        else:
+        elif self.stem == "space_to_depth":
+            n, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(f"space_to_depth stem needs even H/W, got {(h, w)}")
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), name="conv_init_s2d")(x)
+        elif self.stem == "conv7":
             x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         if not self.small_inputs:

@@ -45,7 +45,7 @@ def make_optimizer(
     *,
     optimizer: str = "adam",
     b1: float = 0.9,
-    b2: float = 0.999,
+    b2: float | None = None,  # None → 0.999 (adam/lamb), 0.99 (lion)
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     clip_norm: float | None = None,
@@ -60,6 +60,8 @@ def make_optimizer(
     ``skip_nonfinite_updates`` wraps the chain in
     :func:`tpudist.amp.skip_nonfinite`.
     """
+    if b2 is None:
+        b2 = 0.99 if optimizer == "lion" else 0.999
     parts = []
     if clip_norm is not None:
         parts.append(optax.clip_by_global_norm(clip_norm))
@@ -87,7 +89,7 @@ def make_optimizer(
         # sign-momentum; half the optimizer HBM of Adam (one moment, and it
         # tolerates bf16) — useful when the Adam mirrors dominate memory
         parts.append(
-            optax.lion(lr, b1=b1, b2=0.99 if b2 == 0.999 else b2,
+            optax.lion(lr, b1=b1, b2=b2,
                        weight_decay=weight_decay, mask=decay_mask)
         )
     else:
