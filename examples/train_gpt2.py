@@ -44,7 +44,7 @@ def parse_args(argv=None):
     p.add_argument("--total_steps", default=0, type=int,
                    help="schedule horizon; 0 = epochs x steps_per_epoch")
     p.add_argument("--optimizer", default="adam",
-                   choices=["adam", "sgd", "lamb", "lion"])
+                   choices=["adam", "sgd", "lamb", "lion", "muon"])
     p.add_argument("--weight_decay", default=0.1, type=float)
     p.add_argument("--clip_norm", default=1.0, type=float)
     p.add_argument("--grad_accum", default=1, type=int)

@@ -57,7 +57,7 @@ def parse_args(argv=None):
                         help="ResNet stem; space_to_depth is the MLPerf TPU "
                         "stem (same function class, ~2.5%% faster on v5e)")
     parser.add_argument("--optimizer", default="adam",
-                        choices=["adam", "sgd", "lamb", "lion"],
+                        choices=["adam", "sgd", "lamb", "lion", "muon"],
                         help="reference default: Adam(lr=1e-3), main.py:80")
     parser.add_argument("--weight_decay", default=0.0, type=float,
                         help="decoupled (AdamW) weight decay, 1-D params excluded")

@@ -90,7 +90,7 @@ def test_optimizer_factory_variants():
     grads = {"w": jnp.full((4, 4), 0.1), "b": jnp.full((4,), 0.1)}
     n_param_leaves = len(jax.tree_util.tree_leaves(params))
     moments = {}
-    for name in ("adam", "sgd", "lamb", "lion"):
+    for name in ("adam", "sgd", "lamb", "lion", "muon"):
         tx = make_optimizer(1e-3, optimizer=name, weight_decay=0.01,
                             clip_norm=1.0)
         opt_state = tx.init(params)
@@ -106,3 +106,31 @@ def test_optimizer_factory_variants():
         ) // n_param_leaves
     assert moments["adam"] == 2  # mu + nu
     assert moments["lion"] == 1  # the memory advantage the docstring claims
+
+
+def test_muon_routes_embeddings_to_adam():
+    """Muon orthogonalizes hidden matrices only: embeddings/head (2-D) and
+    non-2-D params ride the Adam partition — the modded-nanogpt recipe."""
+    from tpudist.optim import make_optimizer
+
+    params = {
+        "wte": jnp.ones((8, 4)),          # embedding: 2-D but Adam
+        "lm_head": jnp.ones((8, 4)),      # head: 2-D but Adam
+        "blk": {"kernel": jnp.ones((4, 6)), "bias": jnp.zeros((6,))},
+    }
+    tx = make_optimizer(1e-3, optimizer="muon")
+    state = tx.init(params)
+
+    def shapes(tree):
+        return sorted(
+            tuple(leaf.shape)
+            for leaf in jax.tree_util.tree_leaves(tree)
+            if hasattr(leaf, "shape") and leaf.ndim > 0
+        )
+
+    inner = state.inner_states
+    # only the hidden kernel is Muon-routed; embeddings/head are masked out
+    assert (4, 6) in shapes(inner["muon"])
+    assert (8, 4) not in shapes(inner["muon"])
+    assert (8, 4) in shapes(inner["adam"]) and (6,) in shapes(inner["adam"])
+    assert (4, 6) not in shapes(inner["adam"])

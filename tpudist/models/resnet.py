@@ -108,6 +108,12 @@ class ResNet(nn.Module):
         )
         x = jnp.asarray(x, self.dtype)
         if self.small_inputs:
+            if self.stem != "conv7":
+                raise ValueError(
+                    f"stem={self.stem!r} has no effect with small_inputs "
+                    "(the CIFAR stem is a single 3x3/s1 conv) — drop the "
+                    "stem override rather than silently ignoring it"
+                )
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
         elif self.stem == "space_to_depth":
             n, h, w, c = x.shape

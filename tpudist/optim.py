@@ -85,6 +85,37 @@ def make_optimizer(
             optax.lamb(lr, b1=b1, b2=b2, eps=eps,
                        weight_decay=weight_decay, mask=decay_mask)
         )
+    elif optimizer == "muon":
+        # Newton-Schulz-orthogonalized momentum on hidden matrix params (the
+        # modded-nanogpt optimizer), Adam on everything else — all in-graph,
+        # so the 5 NS iterations fuse into the compiled step. Following the
+        # speedrun recipe, embeddings and the LM head stay on Adam even
+        # though they are 2-D (orthogonalizing their updates hurts), and
+        # weight decay applies to the Muon-routed matrices only (the
+        # Adam-routed remainder is embeddings/heads/biases/norm scales,
+        # which the decay convention already exempts or the recipe leaves
+        # undecayed).
+        from optax.contrib import MuonDimensionNumbers
+
+        _EMBED_NAMES = ("wte", "wpe", "embed", "lm_head", "embedding")
+
+        def muon_dims(params):
+            def label(path, p):
+                names = {getattr(k, "key", str(k)) for k in path}
+                if p.ndim != 2 or names & set(_EMBED_NAMES):
+                    return None  # Adam
+                return MuonDimensionNumbers()
+
+            return jax.tree_util.tree_map_with_path(label, params)
+
+        parts.append(
+            optax.contrib.muon(
+                lr, eps=eps, weight_decay=weight_decay,
+                weight_decay_mask=decay_mask,
+                adam_b1=b1, adam_b2=b2,
+                muon_weight_dimension_numbers=muon_dims,
+            )
+        )
     elif optimizer == "lion":
         # sign-momentum; half the optimizer HBM of Adam (one moment, and it
         # tolerates bf16) — useful when the Adam mirrors dominate memory
