@@ -108,29 +108,97 @@ def test_optimizer_factory_variants():
     assert moments["lion"] == 1  # the memory advantage the docstring claims
 
 
-def test_muon_routes_embeddings_to_adam():
-    """Muon orthogonalizes hidden matrices only: embeddings/head (2-D) and
-    non-2-D params ride the Adam partition — the modded-nanogpt recipe."""
+def _muon_partition_paths(params):
+    """Map each param path to its muon/adam partition by inspecting which
+    partition's moment tree holds a real array (vs MaskedNode) for it."""
     from tpudist.optim import make_optimizer
 
-    params = {
-        "wte": jnp.ones((8, 4)),          # embedding: 2-D but Adam
-        "lm_head": jnp.ones((8, 4)),      # head: 2-D but Adam
-        "blk": {"kernel": jnp.ones((4, 6)), "bias": jnp.zeros((6,))},
-    }
-    tx = make_optimizer(1e-3, optimizer="muon")
+    tx = make_optimizer(1e-3, optimizer="muon", weight_decay=0.01)
     state = tx.init(params)
 
-    def shapes(tree):
-        return sorted(
-            tuple(leaf.shape)
-            for leaf in jax.tree_util.tree_leaves(tree)
-            if hasattr(leaf, "shape") and leaf.ndim > 0
+    def routed(partition):
+        mu = jax.tree_util.tree_leaves_with_path(
+            state.inner_states[partition],
+            is_leaf=lambda x: hasattr(x, "shape"),
         )
+        return {
+            tuple(
+                getattr(k, "key", getattr(k, "name", str(k)))
+                for k in path
+                if type(k).__name__ in ("DictKey", "GetAttrKey")
+            )
+            for path, leaf in mu
+            if hasattr(leaf, "shape")
+        }
 
-    inner = state.inner_states
-    # only the hidden kernel is Muon-routed; embeddings/head are masked out
-    assert (4, 6) in shapes(inner["muon"])
-    assert (8, 4) not in shapes(inner["muon"])
-    assert (8, 4) in shapes(inner["adam"]) and (6,) in shapes(inner["adam"])
-    assert (4, 6) not in shapes(inner["adam"])
+    return routed("muon"), routed("adam")
+
+
+def test_muon_routes_hidden_matrices_not_embeddings():
+    """On a REAL GPT-2 tree: the 4-D qkv and 3-D out kernels are
+    Muon-orthogonalized (via their matrix view), embeddings stay on Adam —
+    the modded-nanogpt recipe. On a ResNet tree: conv kernels get Muon,
+    the anonymous classifier head stays on Adam."""
+    from flax import linen as nn
+
+    from tpudist.models.gpt2 import GPT2
+
+    gpt = GPT2(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=1,
+               num_heads=4)
+    params = nn.meta.unbox(
+        gpt.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                 train=False)["params"]
+    )
+    muon_paths, adam_paths = _muon_partition_paths(params)
+
+    def find(paths, *frags):
+        return any(all(f in "/".join(map(str, p)) for f in frags) for p in paths)
+
+    assert find(muon_paths, "qkv", "kernel")      # 4-D attention kernel
+    assert find(muon_paths, "out", "kernel")      # 3-D out projection
+    assert find(muon_paths, "mlp_fc", "kernel")
+    assert find(adam_paths, "wte") and find(adam_paths, "wpe")
+    assert find(adam_paths, "qkv", "bias")        # 1-D
+    assert not find(adam_paths, "qkv", "kernel")
+
+    from tpudist.models import resnet18
+
+    rn = resnet18(num_classes=10, small_inputs=True)
+    rparams = nn.meta.unbox(
+        rn.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                train=False)["params"]
+    )
+    muon_paths, adam_paths = _muon_partition_paths(rparams)
+    assert find(muon_paths, "conv_init", "kernel")  # 4-D conv
+    assert find(adam_paths, "Dense_0", "kernel")    # the classifier head
+    assert not find(muon_paths, "Dense_0")
+
+
+def test_muon_trains_gpt2_step():
+    """A real optimizer step on GPT-2 params is finite and moves weights."""
+    import optax as _optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.optim import make_optimizer
+    from tpudist.train import (
+        create_train_state, lm_loss, make_train_step, state_shardings_of,
+    )
+    from tpudist.models.gpt2 import GPT2
+
+    mesh = mesh_lib.create_mesh()
+    model = GPT2(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=1,
+                 num_heads=4)
+    tx = make_optimizer(1e-3, optimizer="muon", weight_decay=0.01)
+    state = create_train_state(model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh)
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    rng = np.random.Generator(np.random.PCG64(0))
+    before = np.asarray(state.params["h_0"]["qkv"]["kernel"]).copy()
+    state, metrics = step(
+        state, {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    )
+    assert np.isfinite(float(metrics["loss"]))
+    after = np.asarray(state.params["h_0"]["qkv"]["kernel"])
+    assert not np.array_equal(before, after)

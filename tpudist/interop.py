@@ -1,0 +1,129 @@
+"""Weight interop: Hugging Face checkpoints → tpudist model params.
+
+The reference trains from random init only (SURVEY.md §5: no persistence,
+/root/reference/main.py:40), but a framework its users switch to needs to
+ingest the ecosystem's pretrained weights. These converters map a GPT-2 /
+Llama ``state_dict`` (any mapping of name → array; torch tensors work via
+``numpy()``) onto the exact parameter trees of
+:class:`tpudist.models.gpt2.GPT2` and :class:`tpudist.models.llama.Llama`.
+
+They double as an external correctness oracle: the test suite builds tiny
+randomly-initialized HF models (no network), converts their weights, and
+checks our logits against transformers' — validating attention scaling,
+GELU flavor, LayerNorm/RMSNorm placement, RoPE convention, and GQA head
+layout against an independent implementation.
+
+Layout notes (the whole conversion is layout bookkeeping):
+
+- HF GPT-2 uses ``Conv1D`` (weights stored ``[in, out]`` — same as flax
+  Dense kernels, no transpose); qkv is packed ``[D, 3D]`` column-wise.
+- HF Llama uses ``nn.Linear`` (weights ``[out, in]`` — transpose), heads
+  flattened head-major, which matches ``W.T.reshape(D, H, dh)``.
+- HF Llama's rotary (q·cos + rotate_half(q)·sin over concatenated halves)
+  is exactly :func:`tpudist.models.llama.apply_rope`'s rotate-half form.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np(x) -> np.ndarray:
+    """Accept numpy arrays, jax arrays, or torch tensors."""
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, np.float32)
+
+
+def gpt2_params_from_hf(state_dict, *, depth: int, num_heads: int) -> dict:
+    """HF ``GPT2LMHeadModel``/``GPT2Model`` state dict → ``GPT2`` params.
+
+    The LM head is weight-tied in both implementations, so only ``wte``
+    transfers. Keys may carry the ``transformer.`` prefix or not.
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    wte = _np(sd["wte.weight"])
+    d = wte.shape[1]
+    h = num_heads
+    dh = d // h
+
+    def ln(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    params = {
+        "wte": wte,
+        "wpe": _np(sd["wpe.weight"]),
+        "ln_f": ln("ln_f"),
+    }
+    for i in range(depth):
+        p = f"h.{i}"
+        params[f"h_{i}"] = {
+            "ln_1": ln(f"{p}.ln_1"),
+            "ln_2": ln(f"{p}.ln_2"),
+            # Conv1D packs q|k|v along the output dim: [D, 3D] → [D, 3, H, dh]
+            "qkv": {
+                "kernel": _np(sd[f"{p}.attn.c_attn.weight"]).reshape(d, 3, h, dh),
+                "bias": _np(sd[f"{p}.attn.c_attn.bias"]).reshape(3, h, dh),
+            },
+            # out projection contracts (H, dh) → [H, dh, D]
+            "out": {
+                "kernel": _np(sd[f"{p}.attn.c_proj.weight"]).reshape(h, dh, d),
+                "bias": _np(sd[f"{p}.attn.c_proj.bias"]),
+            },
+            "mlp_fc": {
+                "kernel": _np(sd[f"{p}.mlp.c_fc.weight"]),
+                "bias": _np(sd[f"{p}.mlp.c_fc.bias"]),
+            },
+            "mlp_proj": {
+                "kernel": _np(sd[f"{p}.mlp.c_proj.weight"]),
+                "bias": _np(sd[f"{p}.mlp.c_proj.bias"]),
+            },
+        }
+    return params
+
+
+def llama_params_from_hf(
+    state_dict, *, depth: int, num_heads: int, num_kv_heads: int | None = None,
+) -> dict:
+    """HF ``LlamaForCausalLM``/``LlamaModel`` state dict → ``Llama`` params.
+
+    Handles GQA (``num_kv_heads < num_heads``) and both tied and untied
+    heads (``lm_head`` is emitted only when present and untied — pass the
+    result to a ``Llama(tie_embeddings=...)`` that matches).
+    """
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    embed = _np(sd["embed_tokens.weight"])
+    d = embed.shape[1]
+    h = num_heads
+    kv = num_kv_heads or h
+    dh = d // h
+
+    def lin(key, out_shape):
+        # torch Linear stores [out, in]; flax kernels are [in, out...]
+        return {"kernel": _np(sd[key]).T.reshape(out_shape)}
+
+    params = {
+        "embed": embed,
+        "norm": {"scale": _np(sd["norm.weight"])},
+    }
+    for i in range(depth):
+        p = f"layers.{i}"
+        params[f"layer_{i}"] = {
+            "attn_norm": {"scale": _np(sd[f"{p}.input_layernorm.weight"])},
+            "mlp_norm": {"scale": _np(sd[f"{p}.post_attention_layernorm.weight"])},
+            "q_proj": lin(f"{p}.self_attn.q_proj.weight", (d, h, dh)),
+            "k_proj": lin(f"{p}.self_attn.k_proj.weight", (d, kv, dh)),
+            "v_proj": lin(f"{p}.self_attn.v_proj.weight", (d, kv, dh)),
+            "o_proj": {
+                "kernel": _np(sd[f"{p}.self_attn.o_proj.weight"]).T.reshape(h, dh, d)
+            },
+            "gate_proj": {"kernel": _np(sd[f"{p}.mlp.gate_proj.weight"]).T},
+            "up_proj": {"kernel": _np(sd[f"{p}.mlp.up_proj.weight"]).T},
+            "down_proj": {"kernel": _np(sd[f"{p}.mlp.down_proj.weight"]).T},
+        }
+    if "lm_head.weight" in state_dict:
+        head = _np(state_dict["lm_head.weight"])
+        if not np.shares_memory(head, embed) and not np.array_equal(head, embed):
+            params["lm_head"] = head
+    return params

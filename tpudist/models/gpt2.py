@@ -57,7 +57,7 @@ class Block(nn.Module):
         )
         dense_init = nn.initializers.lecun_normal()
         partitioned = _partitioned if self.tp else (lambda init, *axes: init)
-        y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_1")(x)
         # column-parallel: head dim sharded over 'tensor'
         qkv = nn.DenseGeneral(
             (3, h, d // h), dtype=self.dtype, name="qkv",
@@ -98,7 +98,7 @@ class Block(nn.Module):
             kernel_init=partitioned(dense_init, TENSOR_AXIS, None, None),
         )(attn)
         x = x + drop(y)
-        y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_2")(x)
         if self.num_experts > 0:
             from tpudist.parallel.ep import MoEMlp
 
@@ -165,7 +165,7 @@ class GPT2(nn.Module):
                 moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
                 mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
             )(x, train=train)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head
             # per sequence chunk so the [B,S,V] f32 logits never materialize
@@ -284,7 +284,7 @@ class PipelinedGPT2:
             block_fn, p["blocks"], x, self.mesh, num_micro=self.num_micro
         )
         # same module (and epsilon) as plain GPT2's ln_f
-        x = nn.LayerNorm(dtype=self.dtype).apply({"params": p["ln_f"]}, x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype).apply({"params": p["ln_f"]}, x)
         return jnp.einsum(
             "bsd,vd->bsv", x, p["wte"].astype(self.dtype),
             preferred_element_type=jnp.float32,

@@ -86,27 +86,59 @@ def make_optimizer(
                        weight_decay=weight_decay, mask=decay_mask)
         )
     elif optimizer == "muon":
-        # Newton-Schulz-orthogonalized momentum on hidden matrix params (the
-        # modded-nanogpt optimizer), Adam on everything else — all in-graph,
-        # so the 5 NS iterations fuse into the compiled step. Following the
-        # speedrun recipe, embeddings and the LM head stay on Adam even
-        # though they are 2-D (orthogonalizing their updates hurts), and
-        # weight decay applies to the Muon-routed matrices only (the
-        # Adam-routed remainder is embeddings/heads/biases/norm scales,
-        # which the decay convention already exempts or the recipe leaves
-        # undecayed).
+        # Newton-Schulz-orthogonalized momentum on hidden weight matrices
+        # (the modded-nanogpt optimizer), Adam on everything else — all
+        # in-graph, so the 5 NS iterations fuse into the compiled step.
+        # Following the speedrun recipe, embeddings and classifier/LM heads
+        # stay on Adam even when 2-D (orthogonalizing their updates hurts);
+        # biases/norm scales (1-D) ride Adam too. Weight decay applies to
+        # the Muon-routed matrices (decay_mask is all-true there); the
+        # Adam-routed remainder is exactly the set the recipe leaves
+        # undecayed. Multi-axis kernels are orthogonalized through their
+        # matrix view via MuonDimensionNumbers — qkv [D,3,H,dh] as
+        # D×(3·H·dh), out/o_proj [H,dh,D] as (H·dh)×D, convs [kh,kw,I,O] as
+        # (kh·kw·I)×O — so attention and conv weights get real Muon, not a
+        # silent Adam fallback.
         from optax.contrib import MuonDimensionNumbers
 
-        _EMBED_NAMES = ("wte", "wpe", "embed", "lm_head", "embedding")
+        # top-level param names that are embeddings or heads (wte/wpe/embed/
+        # lm_head/embedding: GPT-2+Llama+ViT embeddings; head: ViT head;
+        # Dense_0: ResNet's anonymous final classifier)
+        _ADAM_TOP = ("wte", "wpe", "embed", "lm_head", "embedding",
+                     "head", "Dense_0")
 
         def muon_dims(params):
             def label(path, p):
-                names = {getattr(k, "key", str(k)) for k in path}
-                if p.ndim != 2 or names & set(_EMBED_NAMES):
+                # train-state bring-up runs tx.init on flax-BOXED params
+                # (nn.Partitioned); updates run on raw arrays — unbox so the
+                # routing (and optax's partition structure) agree between
+                # the two, or the moment trees mismatch at the first step
+                if hasattr(p, "unbox"):
+                    p = p.unbox()
+                top = getattr(path[0], "key", str(path[0]))
+                leaf = getattr(path[-1], "key", str(path[-1]))
+                # only weight kernels orthogonalize: a reshaped multi-dim
+                # BIAS (e.g. qkv's [3,H,dh]) is still a vector per output
+                if p.ndim < 2 or top in _ADAM_TOP or leaf != "kernel":
                     return None  # Adam
-                return MuonDimensionNumbers()
+                names = {getattr(k, "key", str(k)) for k in path}
+                if names & {"out", "o_proj"}:
+                    # DenseGeneral contracting all leading axes → last
+                    return MuonDimensionNumbers(
+                        tuple(range(p.ndim - 1)), (p.ndim - 1,)
+                    )
+                if any("conv" in n.lower() for n in names):
+                    # HWIO conv kernel: spatial+input reduce into output
+                    return MuonDimensionNumbers(
+                        tuple(range(p.ndim - 1)), (p.ndim - 1,)
+                    )
+                # Dense/DenseGeneral splitting the output (qkv [D,3,H,dh],
+                # llama qkv [D,H,dh], plain 2-D): input first, rest output
+                return MuonDimensionNumbers((0,), tuple(range(1, p.ndim)))
 
-            return jax.tree_util.tree_map_with_path(label, params)
+            return jax.tree_util.tree_map_with_path(
+                label, params, is_leaf=lambda x: hasattr(x, "unbox")
+            )
 
         parts.append(
             optax.contrib.muon(
