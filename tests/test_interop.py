@@ -11,7 +11,12 @@ import pytest
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
-from tpudist.interop import gpt2_params_from_hf, llama_params_from_hf  # noqa: E402
+from tpudist.interop import (  # noqa: E402
+    gpt2_params_from_hf,
+    gpt2_params_to_hf,
+    llama_params_from_hf,
+    llama_params_to_hf,
+)
 from tpudist.models.gpt2 import GPT2  # noqa: E402
 from tpudist.models.llama import Llama  # noqa: E402
 
@@ -66,6 +71,83 @@ def test_gpt2_param_tree_matches_model_init():
         jax.tree_util.tree_leaves_with_path(params),
     ):
         assert np.shape(a) == np.shape(b), (pa, np.shape(a), np.shape(b))
+
+
+def test_import_accepts_bf16_checkpoints():
+    """Real HF checkpoints ship/load in bf16 (numpy has no bfloat16) — the
+    importer must upcast, not crash."""
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=1, n_head=4
+    )
+    hf = transformers.GPT2LMHeadModel(cfg).to(torch.bfloat16)
+    params = gpt2_params_from_hf(hf.state_dict(), depth=1, num_heads=4)
+    assert params["wte"].dtype == np.float32
+
+
+def test_gpt2_export_roundtrips_into_transformers():
+    """Our randomly initialized GPT-2, exported to an HF state dict and
+    loaded into transformers, produces the same logits — the other
+    direction of the oracle."""
+    import jax
+    from flax import linen as nn
+
+    model = GPT2(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=2,
+                 num_heads=4)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32),
+                   train=False)["params"]
+    )
+    tokens = _tokens(seed=5)
+    ours = np.asarray(
+        model.apply({"params": params}, jnp.asarray(tokens), train=False)
+    )
+
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager",
+    )
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          gpt2_params_to_hf(params, depth=2).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected
+    assert all("attn.bias" in k or "masked_bias" in k for k in missing), missing
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_export_roundtrips_into_transformers():
+    import jax
+    from flax import linen as nn
+
+    model = Llama(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=2,
+                  num_heads=4, num_kv_heads=2, ffn_dim=64, norm_eps=1e-5)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(8), jnp.zeros((1, 8), jnp.int32),
+                   train=False)["params"]
+    )
+    tokens = _tokens(seed=6)
+    ours = np.asarray(
+        model.apply({"params": params}, jnp.asarray(tokens), train=False)
+    )
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=32, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          llama_params_to_hf(params, depth=2).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
 
 
 @pytest.mark.parametrize("kv_heads,tied", [(4, False), (2, False), (2, True)])

@@ -20,7 +20,7 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _failed = False
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -40,6 +40,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tpd_gather_u8_to_f32.argtypes = [
         c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p, c.c_int64, c.c_void_p,
         c.c_float, c.c_float,
+    ]
+    lib.tpd_gather_u8_to_f32_ch.restype = None
+    lib.tpd_gather_u8_to_f32_ch.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_void_p, c.c_int64,
+        c.c_void_p, c.c_void_p, c.c_void_p,
     ]
     # TCP store (tcpstore.cpp)
     lib.tpd_store_server_create.restype = c.c_void_p

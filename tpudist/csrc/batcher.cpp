@@ -114,7 +114,7 @@ void ParallelChunks(ThreadPool* pool, int64_t n, int64_t min_chunk,
 
 extern "C" {
 
-int tpd_abi_version() { return 1; }
+int tpd_abi_version() { return 2; }
 
 void* tpd_pool_create(int n_threads) {
   if (n_threads <= 0) {
@@ -154,6 +154,30 @@ void tpd_gather_u8_to_f32(void* pool, const uint8_t* src, int64_t item_elems,
                      float* dst = out + i * item_elems;
                      for (int64_t j = 0; j < item_elems; ++j) {
                        dst[j] = static_cast<float>(row[j]) * scale + shift;
+                     }
+                   }
+                 });
+}
+
+// out[i][..., c] = float(src[idx[i]][..., c]) * scale[c] + shift[c] — the
+// gather fused with ToTensor + per-channel normalization ((x/255 - mean)/std
+// folds into one affine per channel). `channels` is the innermost dim of an
+// item; item_elems must be a multiple of it.
+void tpd_gather_u8_to_f32_ch(void* pool, const uint8_t* src,
+                             int64_t item_elems, int64_t channels,
+                             const int64_t* idx, int64_t n, float* out,
+                             const float* scale, const float* shift) {
+  int64_t min_chunk = std::max<int64_t>(1, (1 << 19) / std::max<int64_t>(item_elems, 1));
+  ParallelChunks(static_cast<ThreadPool*>(pool), n, min_chunk,
+                 [=](int64_t s, int64_t e) {
+                   for (int64_t i = s; i < e; ++i) {
+                     const uint8_t* row = src + idx[i] * item_elems;
+                     float* dst = out + i * item_elems;
+                     for (int64_t j = 0; j < item_elems; j += channels) {
+                       for (int64_t c = 0; c < channels; ++c) {
+                         dst[j + c] =
+                             static_cast<float>(row[j + c]) * scale[c] + shift[c];
+                       }
                      }
                    }
                  });

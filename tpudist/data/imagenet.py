@@ -31,14 +31,12 @@ from pathlib import Path
 
 import numpy as np
 
-from tpudist.data.cifar import to_tensor
 from tpudist.data.loader import SampledLoader
 from tpudist.data.sampler import DistributedSampler
 from tpudist.data.transforms import (
     IMAGENET_MEAN,
     IMAGENET_STD,
-    compose,
-    normalize as normalize_transform,
+    to_tensor_normalize,
 )
 
 _EXTENSIONS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
@@ -174,9 +172,9 @@ class ImageFolderLoader(SampledLoader):
         self.seed = seed
         self.drop_remainder = drop_remainder
         # the standard stack from tpudist.data.transforms (one home for the
-        # normalization math + statistics): uint8 → [0,1] → (x−mean)/std
+        # normalization math + statistics): uint8 → (x/255 − mean)/std
         self._transform = (
-            compose(to_tensor, normalize_transform(IMAGENET_MEAN, IMAGENET_STD))
+            to_tensor_normalize(IMAGENET_MEAN, IMAGENET_STD)
             if normalize
             else None
         )

@@ -101,6 +101,37 @@ class NativeBatcher:
         return out
 
 
+    def gather_u8_to_f32_channels(
+        self, src: np.ndarray, idx: np.ndarray,
+        scale: np.ndarray, shift: np.ndarray,
+    ) -> np.ndarray:
+        """out[i][..., c] = f32(src[idx[i]][..., c]) * scale[c] + shift[c] —
+        the gather fused with ToTensor + per-channel normalization
+        ((x/255 − mean)/std folds to one affine per channel)."""
+        if src.dtype != np.uint8:
+            raise TypeError(f"expected uint8 source, got {src.dtype}")
+        _require_contiguous(src)
+        channels = src.shape[-1]
+        scale = np.ascontiguousarray(scale, np.float32)
+        shift = np.ascontiguousarray(shift, np.float32)
+        if scale.shape != (channels,) or shift.shape != (channels,):
+            raise ValueError(
+                f"scale/shift must be shape ({channels},) to match the "
+                f"innermost source dim; got {scale.shape}/{shift.shape}"
+            )
+        idx = _checked_indices(idx, len(src))
+        out = np.empty((len(idx),) + src.shape[1:], np.float32)
+        item_elems = int(np.prod(src.shape[1:], dtype=np.int64))
+        self._lib.tpd_gather_u8_to_f32_ch(
+            self._pool,
+            src.ctypes.data, item_elems, channels,
+            idx.ctypes.data, len(idx),
+            out.ctypes.data,
+            scale.ctypes.data, shift.ctypes.data,
+        )
+        return out
+
+
 _default: NativeBatcher | None = None
 _default_lock = threading.Lock()
 _default_failed = False
@@ -126,9 +157,11 @@ def native_batch(dataset, idx: np.ndarray, transform) -> dict | None:
     """Assemble a batch through the native core, or None if it can't.
 
     ``transform`` participates when it advertises a ``native_spec``
-    (mapping key → (scale, shift) for fused uint8→f32 conversion, e.g.
-    :func:`tpudist.data.cifar.to_tensor`); transforms without a spec force
-    the Python path so arbitrary augmentation keeps working.
+    (mapping key → (scale, shift) for fused uint8→f32 conversion —
+    scalars, e.g. :func:`tpudist.data.cifar.to_tensor`, or per-channel
+    arrays, e.g. :func:`tpudist.data.transforms.to_tensor_normalize`);
+    transforms without a spec force the Python path so arbitrary
+    augmentation keeps working.
     """
     b = default_batcher()
     if b is None:
@@ -142,11 +175,18 @@ def native_batch(dataset, idx: np.ndarray, transform) -> dict | None:
     for k, v in dataset.items():
         if (k in spec and v.dtype != np.uint8) or not v.flags["C_CONTIGUOUS"]:
             return None
+        if k in spec and np.ndim(spec[k][0]) > 0 and (
+            v.ndim < 2 or v.shape[-1] != np.shape(spec[k][0])[0]
+        ):
+            return None  # per-channel spec must match the innermost dim
     out = {}
     for k, v in dataset.items():
         if k in spec:
             scale, shift = spec[k]
-            out[k] = b.gather_u8_to_f32(v, idx, scale, shift)
+            if np.ndim(scale) > 0:
+                out[k] = b.gather_u8_to_f32_channels(v, idx, scale, shift)
+            else:
+                out[k] = b.gather_u8_to_f32(v, idx, scale, shift)
         else:
             out[k] = b.gather(v, idx)
     return out

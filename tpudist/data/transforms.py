@@ -91,21 +91,40 @@ def random_crop_flip(
     return run
 
 
+def to_tensor_normalize(mean, std, key: str = "image"):
+    """ToTensor + per-channel normalize fused into ONE affine on uint8:
+    ``(x/255 − mean)/std  ≡  x · 1/(255·std) − mean/std``.
+
+    Advertises a per-channel ``native_spec`` so the C++ core
+    (``tpd_gather_u8_to_f32_ch``) can fuse the sampler gather, float
+    conversion, and normalization into a single pass with no uint8 or
+    unnormalized-float intermediates.
+    """
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    scale = (1.0 / 255.0) / std
+    shift = -mean / std
+
+    def run(batch):
+        out = dict(batch)
+        out[key] = np.asarray(batch[key], np.float32) * scale + shift
+        return out
+
+    run.native_spec = {key: (scale, shift)}
+    return run
+
+
 def standard_cifar_augment(seed: int = 0, dataset: str = "cifar10"):
-    """crop(pad 4) + flip → ToTensor → normalize — the standard CIFAR
+    """crop(pad 4) + flip → fused ToTensor+normalize — the standard CIFAR
     training pipeline (the reference's is ToTensor only), with the named
     dataset's normalization statistics."""
-    from tpudist.data.cifar import to_tensor
-
     mean, std = _STATS[dataset]
-    return compose(random_crop_flip(seed=seed), to_tensor, normalize(mean, std))
+    return compose(random_crop_flip(seed=seed), to_tensor_normalize(mean, std))
 
 
 def standard_cifar_eval(dataset: str = "cifar10"):
-    """ToTensor → normalize with the SAME statistics as
-    :func:`standard_cifar_augment` (no crop/flip) — the matching eval-time
-    transform; keep the pair together so train/eval can't diverge."""
-    from tpudist.data.cifar import to_tensor
-
+    """The SAME statistics as :func:`standard_cifar_augment` (no crop/flip)
+    — the matching eval-time transform; keep the pair together so
+    train/eval can't diverge. Rides the fused native gather."""
     mean, std = _STATS[dataset]
-    return compose(to_tensor, normalize(mean, std))
+    return to_tensor_normalize(mean, std)

@@ -29,9 +29,10 @@ import numpy as np
 
 
 def _np(x) -> np.ndarray:
-    """Accept numpy arrays, jax arrays, or torch tensors."""
+    """Accept numpy arrays, jax arrays, or torch tensors (incl. bf16 —
+    numpy has no bfloat16, so torch tensors upcast before .numpy())."""
     if hasattr(x, "detach"):  # torch tensor
-        x = x.detach().cpu().numpy()
+        x = x.detach().cpu().float().numpy()
     return np.asarray(x, np.float32)
 
 
@@ -127,3 +128,71 @@ def llama_params_from_hf(
         if not np.shares_memory(head, embed) and not np.array_equal(head, embed):
             params["lm_head"] = head
     return params
+
+
+def gpt2_params_to_hf(params, *, depth: int) -> dict:
+    """Inverse of :func:`gpt2_params_from_hf`: ``GPT2`` params → a state
+    dict loadable by HF ``GPT2LMHeadModel.load_state_dict(strict=False)``
+    (strict=False only because HF registers non-weight buffers like the
+    causal-mask ``attn.bias``)."""
+    from flax import linen as nn
+
+    p = nn.meta.unbox(params)
+    wte = _np(p["wte"])
+    d = wte.shape[1]
+    sd = {
+        "transformer.wte.weight": wte,
+        "transformer.wpe.weight": _np(p["wpe"]),
+        "transformer.ln_f.weight": _np(p["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _np(p["ln_f"]["bias"]),
+        "lm_head.weight": wte,  # tied
+    }
+    for i in range(depth):
+        blk = p[f"h_{i}"]
+        o = f"transformer.h.{i}"
+        sd[f"{o}.ln_1.weight"] = _np(blk["ln_1"]["scale"])
+        sd[f"{o}.ln_1.bias"] = _np(blk["ln_1"]["bias"])
+        sd[f"{o}.ln_2.weight"] = _np(blk["ln_2"]["scale"])
+        sd[f"{o}.ln_2.bias"] = _np(blk["ln_2"]["bias"])
+        sd[f"{o}.attn.c_attn.weight"] = _np(blk["qkv"]["kernel"]).reshape(d, 3 * d)
+        sd[f"{o}.attn.c_attn.bias"] = _np(blk["qkv"]["bias"]).reshape(3 * d)
+        sd[f"{o}.attn.c_proj.weight"] = _np(blk["out"]["kernel"]).reshape(d, d)
+        sd[f"{o}.attn.c_proj.bias"] = _np(blk["out"]["bias"])
+        sd[f"{o}.mlp.c_fc.weight"] = _np(blk["mlp_fc"]["kernel"])
+        sd[f"{o}.mlp.c_fc.bias"] = _np(blk["mlp_fc"]["bias"])
+        sd[f"{o}.mlp.c_proj.weight"] = _np(blk["mlp_proj"]["kernel"])
+        sd[f"{o}.mlp.c_proj.bias"] = _np(blk["mlp_proj"]["bias"])
+    return sd
+
+
+def llama_params_to_hf(params, *, depth: int) -> dict:
+    """Inverse of :func:`llama_params_from_hf`: ``Llama`` params → a state
+    dict loadable by HF ``LlamaForCausalLM.load_state_dict`` (tied models
+    emit ``lm_head.weight`` = embedding, matching
+    ``tie_word_embeddings=True``)."""
+    from flax import linen as nn
+
+    p = nn.meta.unbox(params)
+    embed = _np(p["embed"])
+    d = embed.shape[1]
+    sd = {
+        "model.embed_tokens.weight": embed,
+        "model.norm.weight": _np(p["norm"]["scale"]),
+        "lm_head.weight": _np(p.get("lm_head", p["embed"])),
+    }
+    for i in range(depth):
+        blk = p[f"layer_{i}"]
+        o = f"model.layers.{i}"
+        sd[f"{o}.input_layernorm.weight"] = _np(blk["attn_norm"]["scale"])
+        sd[f"{o}.post_attention_layernorm.weight"] = _np(blk["mlp_norm"]["scale"])
+        for ours, theirs in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                             ("v_proj", "v_proj")):
+            k = _np(blk[ours]["kernel"])           # [D, H, dh]
+            sd[f"{o}.self_attn.{theirs}.weight"] = k.reshape(d, -1).T
+        sd[f"{o}.self_attn.o_proj.weight"] = (
+            _np(blk["o_proj"]["kernel"]).reshape(-1, d).T
+        )
+        sd[f"{o}.mlp.gate_proj.weight"] = _np(blk["gate_proj"]["kernel"]).T
+        sd[f"{o}.mlp.up_proj.weight"] = _np(blk["up_proj"]["kernel"]).T
+        sd[f"{o}.mlp.down_proj.weight"] = _np(blk["down_proj"]["kernel"]).T
+    return sd
