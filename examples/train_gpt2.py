@@ -90,6 +90,11 @@ def parse_args(argv=None):
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
     p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses", "ulysses_flash"])
+    p.add_argument("--generate", default=0, type=int,
+                   help="after training, KV-cache-generate this many tokens "
+                   "from the start of the stream (greedy unless --temperature)")
+    p.add_argument("--temperature", default=0.0, type=float)
+    p.add_argument("--top_k", default=None, type=int)
     p.add_argument("--eval", action="store_true",
                    help="after training, report next-token loss + perplexity "
                    "over --val_tokens (or the training stream if unset)")
@@ -135,13 +140,18 @@ def main(argv=None):
     from tpudist.optim import make_optimizer, warmup_cosine
     from tpudist.train import fit, lm_loss
 
-    if args.eval and (args.cp > 1 or args.pipe > 1):
-        # fail fast, BEFORE the (possibly hours-long) training run: cp eval
-        # would need the plain forward, pipe eval batches padded to
-        # num_micro — neither is what evaluate_lm does
+    if (args.eval or args.generate) and (args.cp > 1 or args.pipe > 1):
+        # fail fast, BEFORE the (possibly hours-long) training run: cp
+        # eval/decode would need the plain forward, pipe eval batches padded
+        # to num_micro — neither is what evaluate_lm/generate does
         raise SystemExit(
-            "--eval supports the non-cp, non-pipe paths; rerun eval "
-            "separately without --cp/--pipe"
+            "--eval/--generate support the non-cp, non-pipe paths; rerun "
+            "them separately without --cp/--pipe"
+        )
+    if args.generate and args.generate >= args.seq_len:
+        raise SystemExit(
+            f"--generate {args.generate} must be < --seq_len {args.seq_len} "
+            "(the KV cache is seq_len slots)"
         )
 
     ctx = init_from_env()
@@ -278,6 +288,27 @@ def main(argv=None):
             f"tokens/sec: {seqs * args.seq_len / wall:.1f} "
             f"(global, incl. compile) steps={n_steps} final_loss={losses[-1]:.4f}"
         )
+
+    if args.generate:
+        # EVERY process runs the (collective) jitted decode — params are
+        # global arrays; the prompt is identical everywhere (same stream),
+        # so outputs agree and only rank 0 prints
+        import numpy as np
+
+        from tpudist.generate import generate
+
+        prompt_len = max(1, min(32, args.seq_len - args.generate))
+        prompt = np.asarray(token_source(args)[:prompt_len], np.int32)[None]
+        out = generate(
+            model, state.params, prompt, args.generate,
+            temperature=args.temperature, top_k=args.top_k,
+        )[0]
+        if ctx.process_index == 0:
+            print(f"generated tokens: {out.tolist()}")
+            if args.vocab_size <= 256:
+                # byte-level vocab decodes straight back to text
+                text = bytes(int(t) % 256 for t in out).decode("utf-8", "replace")
+                print(f"generated text: {text!r}")
 
     if args.eval:
         from tpudist.train import evaluate_lm

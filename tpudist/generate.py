@@ -1,0 +1,90 @@
+"""Autoregressive text generation with a KV cache.
+
+No reference counterpart (the reference is a training-only CNN script); this
+is the inference half every LM framework needs. TPU-first design: the whole
+generation — prompt prefill and sampling — is ONE jit-compiled program.
+Both phases are ``lax.scan`` over single-token decode steps against a
+static-shaped ``[B, max_seq_len, H, dh]`` KV cache
+(:mod:`tpudist.ops.decode`), so there is exactly one compilation regardless
+of prompt length or tokens requested, and the cache never reallocates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sample_logits(logits, rng, *, temperature: float = 1.0,
+                  top_k: int | None = None):
+    """One sampling step over ``[B, V]`` logits. ``temperature=0`` is
+    greedy; ``top_k`` keeps only the k most likely tokens."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        k = min(top_k, logits.shape[-1])  # clamp like HF/torch samplers
+        kth = jax.lax.top_k(logits, k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    model,
+    params,
+    prompt,
+    max_new_tokens: int,
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    seed: int = 0,
+) -> np.ndarray:
+    """Continue ``prompt`` (``[B, P]`` int tokens) by ``max_new_tokens``.
+
+    Works for any model with the decode contract (``decode=True`` +
+    ``cache`` collection): GPT-2 and Llama. Returns ``[B, max_new_tokens]``
+    int32. Greedy when ``temperature=0``, else temperature/top-k sampling.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    if p + max_new_tokens > model.max_seq_len:
+        raise ValueError(
+            f"prompt {p} + {max_new_tokens} new tokens exceeds the model's "
+            f"max_seq_len {model.max_seq_len} (the KV cache size)"
+        )
+
+    cache = model.init(
+        jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
+        train=False, decode=True,
+    )["cache"]
+
+    def decode_step(cache, tok):
+        """tok [B] → (updated cache, [B, V] logits for the next position)."""
+        logits, updates = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, decode=True, mutable=["cache"],
+        )
+        return updates["cache"], logits[:, -1]
+
+    @jax.jit
+    def run(cache, prompt, rng):
+        # prefill: feed prompt tokens through the cache, keep the last logits
+        cache, logits = jax.lax.scan(decode_step, cache, prompt.T)
+
+        def sample_step(carry, _):
+            cache, last_logits, rng = carry
+            rng, sub = jax.random.split(rng)
+            tok = sample_logits(
+                last_logits, sub, temperature=temperature, top_k=top_k
+            )
+            cache, next_logits = decode_step(cache, tok)
+            return (cache, next_logits, rng), tok
+
+        (cache, _, _), toks = jax.lax.scan(
+            sample_step, (cache, logits[-1], rng),
+            None, length=max_new_tokens,
+        )
+        return toks.T  # [B, max_new_tokens]
+
+    return np.asarray(run(cache, prompt, jax.random.key(seed)))

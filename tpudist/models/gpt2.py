@@ -48,7 +48,8 @@ class Block(nn.Module):
     dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, decode: bool = False,
+                 max_len: int = 0):
         b, s, d = x.shape
         h = self.num_heads
         drop = lambda y: (
@@ -65,7 +66,20 @@ class Block(nn.Module):
             bias_init=partitioned(nn.initializers.zeros_init(), None, TENSOR_AXIS, None),
         )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
+        if decode:
+            # autoregressive KV-cache attention (tpudist.ops.decode): the
+            # context-parallel impls don't apply to single-token steps
+            if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
+                raise ValueError(
+                    f"attn_impl={self.attn_impl!r} has no decode path; "
+                    "generate with the xla/flash model"
+                )
+            from tpudist.ops.attention import dot_product_attention
+            from tpudist.ops.decode import cached_kv
+
+            keys, values, mask, _ = cached_kv(self, k, v, max_len)
+            attn = dot_product_attention(q, keys, values, mask=mask)
+        elif self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
             # context-parallel attention over the 'seq' mesh axis
             # (tpudist.parallel.cp); activations arrive sequence-sharded and
             # the shard_map keeps them that way — requires ``mesh``
@@ -144,7 +158,8 @@ class GPT2(nn.Module):
         return self.num_experts > 0
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
+                 decode: bool = False):
         b, s = tokens.shape
         wte = self.param(
             "wte",
@@ -154,7 +169,20 @@ class GPT2(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (self.max_seq_len, self.hidden_dim), jnp.float32
         )
-        x = wte[tokens].astype(self.dtype) + wpe[:s].astype(self.dtype)
+        if decode:
+            # learned positions follow the cache cursor, not [0, s); the
+            # init trace only creates the counter (no advance)
+            initialized = self.has_variable("cache", "position")
+            pos_var = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+            )
+            pos = jax.lax.dynamic_slice(wpe, (pos_var.value, 0),
+                                        (s, self.hidden_dim))
+            if initialized:
+                pos_var.value = pos_var.value + s
+        else:
+            pos = wpe[:s]
+        x = wte[tokens].astype(self.dtype) + pos.astype(self.dtype)
         if self.dropout:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.depth):
@@ -164,7 +192,7 @@ class GPT2(nn.Module):
                 num_experts=self.num_experts if moe_here else 0,
                 moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
                 mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
-            )(x, train=train)
+            )(x, train=train, decode=decode, max_len=self.max_seq_len)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head

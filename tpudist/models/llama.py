@@ -61,7 +61,8 @@ class LlamaBlock(nn.Module):
     norm_eps: float = 1e-5
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, decode: bool = False,
+                 max_len: int = 0):
         b, s, d = x.shape
         h, kv = self.num_heads, self.num_kv_heads
         if h % kv:
@@ -81,34 +82,64 @@ class LlamaBlock(nn.Module):
         v = nn.DenseGeneral((kv, dh), use_bias=False, dtype=self.dtype,
                             name="v_proj",
                             kernel_init=_partitioned(dense_init, None, TENSOR_AXIS, None))(y)
-        q = apply_rope(q, theta=self.rope_theta)
-        k = apply_rope(k, theta=self.rope_theta)
-        if kv != h:
-            # GQA: broadcast each K/V head over its query group; XLA fuses
-            # the repeat into the attention matmuls
-            k = jnp.repeat(k, h // kv, axis=2)
-            v = jnp.repeat(v, h // kv, axis=2)
-        if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
-            if self.mesh is None:
+        if decode:
+            # KV-cache decode (tpudist.ops.decode): keys are rotated at
+            # their absolute positions BEFORE caching, so the cache holds
+            # position-encoded keys; q rotates at the same offset
+            if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
                 raise ValueError(
-                    f"attn_impl={self.attn_impl!r} needs the model's mesh= "
-                    "field set (the shard_map runs over its 'seq' axis)"
+                    f"attn_impl={self.attn_impl!r} has no decode path; "
+                    "generate with the xla/flash model"
                 )
-            from tpudist.parallel.cp import ring_attention, ulysses_attention
+            from tpudist.ops.attention import dot_product_attention
+            from tpudist.ops.decode import cached_kv
 
-            if self.attn_impl == "ring":
-                attn = ring_attention(q, k, v, self.mesh, causal=True)
-            else:
-                attn_fn = None
-                if self.attn_impl == "ulysses_flash":
-                    from tpudist.ops.flash_attention import flash_attention
+            def rotate_k(k, v, pos):
+                positions = (pos + jnp.arange(s)).astype(jnp.float32)
+                return apply_rope(k, theta=self.rope_theta,
+                                  positions=positions), v
 
-                    attn_fn = flash_attention
-                attn = ulysses_attention(
-                    q, k, v, self.mesh, causal=True, attn_fn=attn_fn
-                )
+            keys, values, mask, pos = cached_kv(
+                self, k, v, max_len, pre_update=rotate_k
+            )
+            q = apply_rope(q, theta=self.rope_theta,
+                           positions=(pos + jnp.arange(s)).astype(jnp.float32))
+            if kv != h:
+                keys = jnp.repeat(keys, h // kv, axis=2)
+                values = jnp.repeat(values, h // kv, axis=2)
+            attn = dot_product_attention(q, keys, values, mask=mask)
         else:
-            attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
+            q = apply_rope(q, theta=self.rope_theta)
+            k = apply_rope(k, theta=self.rope_theta)
+            if kv != h:
+                # GQA: broadcast each K/V head over its query group; XLA
+                # fuses the repeat into the attention matmuls
+                k = jnp.repeat(k, h // kv, axis=2)
+                v = jnp.repeat(v, h // kv, axis=2)
+            if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
+                if self.mesh is None:
+                    raise ValueError(
+                        f"attn_impl={self.attn_impl!r} needs the model's "
+                        "mesh= field set (the shard_map runs over its 'seq' "
+                        "axis)"
+                    )
+                from tpudist.parallel.cp import ring_attention, ulysses_attention
+
+                if self.attn_impl == "ring":
+                    attn = ring_attention(q, k, v, self.mesh, causal=True)
+                else:
+                    attn_fn = None
+                    if self.attn_impl == "ulysses_flash":
+                        from tpudist.ops.flash_attention import flash_attention
+
+                        attn_fn = flash_attention
+                    attn = ulysses_attention(
+                        q, k, v, self.mesh, causal=True, attn_fn=attn_fn
+                    )
+            else:
+                attn = multi_head_attention(
+                    q, k, v, causal=True, impl=self.attn_impl
+                )
         # row-parallel output projection; GSPMD all-reduces over 'tensor'
         x = x + nn.DenseGeneral(
             d, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="o_proj",
@@ -147,7 +178,8 @@ class Llama(nn.Module):
     norm_eps: float = 1e-5
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
+                 decode: bool = False):
         b, s = tokens.shape
         if s > self.max_seq_len:
             raise ValueError(f"sequence {s} exceeds max_seq_len {self.max_seq_len}")
@@ -164,7 +196,7 @@ class Llama(nn.Module):
                 self.num_heads, kv, ffn, dtype=self.dtype,
                 attn_impl=self.attn_impl, rope_theta=self.rope_theta,
                 mesh=self.mesh, norm_eps=self.norm_eps, name=f"layer_{i}",
-            )(x, train=train)
+            )(x, train=train, decode=decode, max_len=self.max_seq_len)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")(x)
         if return_hidden:
             # the chunked-CE path applies the head per sequence chunk so the

@@ -1,0 +1,59 @@
+"""Autoregressive KV-cache machinery for decode-mode attention.
+
+No reference counterpart (the reference trains a CNN); this serves the LM
+families' generation path (:mod:`tpudist.generate`). TPU-first shape
+discipline: the cache is a fixed ``[B, max_len, H, dh]`` buffer updated with
+``dynamic_update_slice`` and attention masks are computed against the full
+buffer — everything static-shaped, so one compiled step serves every
+position and ``lax.scan`` drives the whole generation loop in-graph.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cached_kv(module, k, v, max_len: int, pre_update=None):
+    """Append this step's K/V into the module's decode cache.
+
+    Must be called inside a flax module's ``__call__`` (it creates
+    ``cache`` collection variables). ``k``/``v``: ``[B, s, H, dh]`` for the
+    current step (``s`` is 1 during sampling; larger chunks work if the
+    caller masks causality within the chunk — our callers feed 1).
+
+    ``pre_update(k, v, position) -> (k, v)`` runs before the write with the
+    step's absolute position — RoPE models rotate keys here so the cache
+    holds position-encoded keys.
+
+    Returns ``(keys, values, mask, position)``: the full cache buffers, a
+    ``[1, 1, s, max_len]`` attention mask over valid (already-written)
+    slots, and the integer position where this step was written (for
+    RoPE / learned-position lookup).
+    """
+    b, s, h, dh = k.shape
+    # the init trace only CREATES the cache (shape/dtype); mutating there
+    # would hand callers a cache already advanced past position 0
+    initialized = module.has_variable("cache", "cached_key")
+    ck = module.variable(
+        "cache", "cached_key", jnp.zeros, (b, max_len, h, dh), k.dtype
+    )
+    cv = module.variable(
+        "cache", "cached_value", jnp.zeros, (b, max_len, h, dh), v.dtype
+    )
+    ci = module.variable(
+        "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+    )
+    pos = ci.value
+    if pre_update is not None:
+        k, v = pre_update(k, v, pos)
+    if initialized:
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
+        ci.value = pos + s
+    # slot t is attendable by step row i iff t <= pos + i (causal over the
+    # buffer; unwritten slots are masked out entirely)
+    slots = jnp.arange(max_len)[None, None, None, :]
+    rows = pos + jnp.arange(s)[None, None, :, None]
+    mask = slots <= rows
+    return ck.value, cv.value, mask, pos
