@@ -90,6 +90,10 @@ def parse_args(argv=None):
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
     p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses", "ulysses_flash"])
+    p.add_argument("--init_hf", default=None, type=str,
+                   help="warm-start from a LOCAL HF checkpoint dir/file "
+                   "(*.safetensors or pytorch_model*.bin) converted via "
+                   "tpudist.interop; sizes must match the model flags")
     p.add_argument("--generate", default=0, type=int,
                    help="after training, KV-cache-generate this many tokens "
                    "from the start of the stream (greedy unless --temperature)")
@@ -140,6 +144,12 @@ def main(argv=None):
     from tpudist.optim import make_optimizer, warmup_cosine
     from tpudist.train import fit, lm_loss
 
+    cp_attn = args.attn in ("ring", "ulysses", "ulysses_flash")
+    if args.generate and cp_attn:
+        raise SystemExit(
+            f"--attn {args.attn} has no decode path; --generate needs the "
+            "xla/flash model"
+        )
     if (args.eval or args.generate) and (args.cp > 1 or args.pipe > 1):
         # fail fast, BEFORE the (possibly hours-long) training run: cp
         # eval/decode would need the plain forward, pipe eval batches padded
@@ -260,6 +270,25 @@ def main(argv=None):
         )
         batch_spec = {"tokens": shape}
 
+    init_params = None
+    if args.init_hf:
+        from tpudist.interop import (
+            gpt2_params_from_hf, llama_params_from_hf, load_hf_state_dict,
+        )
+
+        if args.pipe > 1:
+            raise SystemExit("--init_hf supports the non-pipe models")
+        sd = load_hf_state_dict(args.init_hf)
+        if args.arch == "llama":
+            init_params = llama_params_from_hf(
+                sd, depth=args.depth, num_heads=args.num_heads,
+                num_kv_heads=args.num_kv_heads or None,
+            )
+        else:
+            init_params = gpt2_params_from_hf(
+                sd, depth=args.depth, num_heads=args.num_heads
+            )
+
     import time
 
     # throughput accounting counts data-parallel replicas (the reference's
@@ -279,6 +308,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
+        init_params=init_params,
     )
     wall = time.time() - t0
     n_steps = len(losses)

@@ -38,6 +38,13 @@ def parse_args(argv=None):
     # same defaults
     parser.add_argument("--epochs", default=2, type=int)
     parser.add_argument("--lr", default=0.001, type=float)
+    parser.add_argument("--schedule", default="constant",
+                        choices=["constant", "cosine"],
+                        help="constant = reference parity (fixed lr, "
+                        "main.py:32); cosine adds linear warmup + cosine "
+                        "decay over the full run")
+    parser.add_argument("--warmup_steps", default=0, type=int,
+                        help="warmup steps for --schedule cosine")
     # capability knobs beyond the reference CLI
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "vit_b16", "gpt2"])
@@ -161,8 +168,20 @@ def main(argv=None):
     from tpudist.optim import make_optimizer
 
     # defaults reproduce the reference's Adam(lr=1e-3) (main.py:80) exactly
+    if args.schedule == "cosine":
+        from tpudist.optim import warmup_cosine
+
+        # one optimizer step per loader batch (grad accumulation splits the
+        # batch into microbatches, it does not reduce the step count)
+        total = max(args.epochs * len(loader), 1)
+        lr = warmup_cosine(
+            args.lr, warmup_steps=min(args.warmup_steps, total // 2),
+            total_steps=total,
+        )
+    else:
+        lr = args.lr
     tx = make_optimizer(
-        args.lr, optimizer=args.optimizer,
+        lr, optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
     state, losses = fit(

@@ -84,6 +84,32 @@ def test_import_accepts_bf16_checkpoints():
     assert params["wte"].dtype == np.float32
 
 
+def test_load_hf_state_dict_formats(tmp_path):
+    """Local checkpoint loading: safetensors dirs (preferred), .bin
+    fallback, missing path errors."""
+    from tpudist.interop import load_hf_state_dict
+
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=1, n_head=4
+    )
+    hf = transformers.GPT2LMHeadModel(cfg)
+    st_dir = tmp_path / "st"
+    hf.save_pretrained(st_dir)  # writes model.safetensors
+    sd = load_hf_state_dict(st_dir)
+    assert any(k.endswith("wte.weight") for k in sd)
+
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    torch.save(hf.state_dict(), bin_dir / "pytorch_model.bin")
+    sd2 = load_hf_state_dict(bin_dir)
+    got = {k.removeprefix("transformer."): v for k, v in sd2.items()}
+    np.testing.assert_array_equal(
+        got["wte.weight"].numpy(), hf.state_dict()["transformer.wte.weight"].numpy()
+    )
+    with pytest.raises(FileNotFoundError):
+        load_hf_state_dict(tmp_path / "nope")
+
+
 def test_gpt2_export_roundtrips_into_transformers():
     """Our randomly initialized GPT-2, exported to an HF state dict and
     loaded into transformers, produces the same logits — the other

@@ -11,6 +11,8 @@ of prompt length or tokens requested, and the cache never reallocates.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,10 +56,34 @@ def generate(
             f"max_seq_len {model.max_seq_len} (the KV cache size)"
         )
 
-    cache = model.init(
-        jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
-        train=False, decode=True,
+    # cache shapes WITHOUT materializing a throwaway second copy of the
+    # params (model.init would — a 2× HBM spike at 7B scale)
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
+            train=False, decode=True,
+        )
     )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+    out = _run(
+        model, params, cache, prompt, jax.random.key(seed),
+        max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k,
+    )
+    return np.asarray(out)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k"),
+)
+def _run(model, params, cache, prompt, rng, *, max_new_tokens, temperature,
+         top_k):
+    """One compiled program for prefill + sampling. ``params`` is a traced
+    argument (not a closure constant), and jit caches on the static
+    (model, length, sampling) config — repeated generate() calls with the
+    same setup reuse the compilation."""
 
     def decode_step(cache, tok):
         """tok [B] → (updated cache, [B, V] logits for the next position)."""
@@ -67,24 +93,19 @@ def generate(
         )
         return updates["cache"], logits[:, -1]
 
-    @jax.jit
-    def run(cache, prompt, rng):
-        # prefill: feed prompt tokens through the cache, keep the last logits
-        cache, logits = jax.lax.scan(decode_step, cache, prompt.T)
+    # prefill: feed prompt tokens through the cache, keep the last logits
+    cache, logits = jax.lax.scan(decode_step, cache, prompt.T)
 
-        def sample_step(carry, _):
-            cache, last_logits, rng = carry
-            rng, sub = jax.random.split(rng)
-            tok = sample_logits(
-                last_logits, sub, temperature=temperature, top_k=top_k
-            )
-            cache, next_logits = decode_step(cache, tok)
-            return (cache, next_logits, rng), tok
-
-        (cache, _, _), toks = jax.lax.scan(
-            sample_step, (cache, logits[-1], rng),
-            None, length=max_new_tokens,
+    def sample_step(carry, _):
+        cache, last_logits, rng = carry
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(
+            last_logits, sub, temperature=temperature, top_k=top_k
         )
-        return toks.T  # [B, max_new_tokens]
+        cache, next_logits = decode_step(cache, tok)
+        return (cache, next_logits, rng), tok
 
-    return np.asarray(run(cache, prompt, jax.random.key(seed)))
+    (cache, _, _), toks = jax.lax.scan(
+        sample_step, (cache, logits[-1], rng), None, length=max_new_tokens
+    )
+    return toks.T  # [B, max_new_tokens]

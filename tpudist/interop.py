@@ -36,6 +36,40 @@ def _np(x) -> np.ndarray:
     return np.asarray(x, np.float32)
 
 
+def load_hf_state_dict(path) -> dict:
+    """A local HF checkpoint (dir or single file) → {name: tensor}.
+
+    Reads ``*.safetensors`` (preferred; sharded checkpoints concatenate) or
+    ``pytorch_model*.bin``. No network access — point it at a directory
+    downloaded elsewhere (``from_pretrained``'s cache layout works).
+    """
+    from pathlib import Path
+
+    p = Path(path)
+    if p.is_dir():
+        files = sorted(p.glob("*.safetensors")) or sorted(p.glob("pytorch_model*.bin"))
+        if not files:
+            raise FileNotFoundError(
+                f"{p} holds no *.safetensors or pytorch_model*.bin"
+            )
+    elif p.exists():
+        files = [p]
+    else:
+        raise FileNotFoundError(str(p))
+    sd = {}
+    for f in files:
+        if f.suffix == ".safetensors":
+            # the torch loader handles bf16 (numpy has no bfloat16)
+            from safetensors.torch import load_file
+
+            sd.update(load_file(str(f)))
+        else:
+            import torch
+
+            sd.update(torch.load(f, map_location="cpu", weights_only=True))
+    return sd
+
+
 def gpt2_params_from_hf(state_dict, *, depth: int, num_heads: int) -> dict:
     """HF ``GPT2LMHeadModel``/``GPT2Model`` state dict → ``GPT2`` params.
 

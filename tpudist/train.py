@@ -321,6 +321,7 @@ def fit(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
     resume: bool = True,
+    init_params=None,
 ) -> tuple[TrainState, list[float]]:
     """The reference's whole training program (/root/reference/main.py:86-117)
     as a function: epochs × batches, per-epoch sampler re-shuffle, windowed
@@ -364,6 +365,17 @@ def fit(
         sample_in.dtype,
     )
     state = create_train_state(model, seed, init_input, tx, mesh)
+    if init_params is not None:
+        # warm-start (e.g. an HF checkpoint through tpudist.interop):
+        # replace the random init leaf-for-leaf, keeping each leaf's mesh
+        # placement and dtype; optimizer state stays fresh
+        placed = jax.tree_util.tree_map(
+            lambda ref, new: jax.device_put(
+                jnp.asarray(new, ref.dtype), ref.sharding
+            ),
+            state.params, init_params,
+        )
+        state = state.replace(params=placed)
     # DDP verifies rank param consistency at wrap time (main.py:83); same
     # check here — same seed must have produced identical params (no-op
     # single-process)
