@@ -141,7 +141,7 @@ def main(argv=None):
     from tpudist import init_from_env
     from tpudist import mesh as mesh_lib
     from tpudist.models.gpt2 import GPT2, PipelinedGPT2
-    from tpudist.optim import make_optimizer, warmup_cosine
+    from tpudist.optim import make_optimizer, run_schedule
     from tpudist.train import fit, lm_loss
 
     cp_attn = args.attn in ("ring", "ulysses", "ulysses_flash")
@@ -242,10 +242,10 @@ def main(argv=None):
     )
 
     steps_per_epoch = len(loader)
-    total = args.total_steps or max(args.epochs * steps_per_epoch, 1)
+    total = args.total_steps or args.epochs * steps_per_epoch
     tx = make_optimizer(
-        warmup_cosine(args.lr, warmup_steps=min(args.warmup_steps, total // 2),
-                      total_steps=total),
+        run_schedule(args.lr, total_steps=total,
+                     warmup_steps=args.warmup_steps),
         optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
@@ -272,22 +272,14 @@ def main(argv=None):
 
     init_params = None
     if args.init_hf:
-        from tpudist.interop import (
-            gpt2_params_from_hf, llama_params_from_hf, load_hf_state_dict,
-        )
+        from tpudist.interop import load_hf_params
 
         if args.pipe > 1:
             raise SystemExit("--init_hf supports the non-pipe models")
-        sd = load_hf_state_dict(args.init_hf)
-        if args.arch == "llama":
-            init_params = llama_params_from_hf(
-                sd, depth=args.depth, num_heads=args.num_heads,
-                num_kv_heads=args.num_kv_heads or None,
-            )
-        else:
-            init_params = gpt2_params_from_hf(
-                sd, depth=args.depth, num_heads=args.num_heads
-            )
+        init_params = load_hf_params(
+            args.init_hf, arch=args.arch, depth=args.depth,
+            num_heads=args.num_heads, num_kv_heads=args.num_kv_heads or None,
+        )
 
     import time
 

@@ -169,14 +169,11 @@ def main(argv=None):
 
     # defaults reproduce the reference's Adam(lr=1e-3) (main.py:80) exactly
     if args.schedule == "cosine":
-        from tpudist.optim import warmup_cosine
+        from tpudist.optim import run_schedule
 
-        # one optimizer step per loader batch (grad accumulation splits the
-        # batch into microbatches, it does not reduce the step count)
-        total = max(args.epochs * len(loader), 1)
-        lr = warmup_cosine(
-            args.lr, warmup_steps=min(args.warmup_steps, total // 2),
-            total_steps=total,
+        lr = run_schedule(
+            args.lr, total_steps=args.epochs * len(loader),
+            warmup_steps=args.warmup_steps,
         )
     else:
         lr = args.lr

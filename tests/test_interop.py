@@ -84,6 +84,32 @@ def test_import_accepts_bf16_checkpoints():
     assert params["wte"].dtype == np.float32
 
 
+def test_save_hf_checkpoint_roundtrip(tmp_path):
+    """tpudist → safetensors on disk → back through the importer: byte-
+    identical weights (the full ecosystem hand-off loop)."""
+    import jax
+    from flax import linen as nn
+
+    from tpudist.interop import load_hf_state_dict, save_hf_checkpoint
+
+    model = GPT2(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=1,
+                 num_heads=4)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(4), jnp.zeros((1, 8), jnp.int32),
+                   train=False)["params"]
+    )
+    save_hf_checkpoint(params, tmp_path / "export", arch="gpt2", depth=1)
+    back = gpt2_params_from_hf(
+        load_hf_state_dict(tmp_path / "export"), depth=1, num_heads=4
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(back),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a, np.float32), b)
+
+
 def test_load_hf_state_dict_formats(tmp_path):
     """Local checkpoint loading: safetensors dirs (preferred), .bin
     fallback, missing path errors."""

@@ -164,6 +164,44 @@ def llama_params_from_hf(
     return params
 
 
+def load_hf_params(
+    path, *, arch: str, depth: int, num_heads: int,
+    num_kv_heads: int | None = None,
+) -> dict:
+    """One-call warm-start: local HF checkpoint → tpudist params for the
+    named architecture (the import-side twin of :func:`save_hf_checkpoint`)."""
+    sd = load_hf_state_dict(path)
+    if arch == "gpt2":
+        return gpt2_params_from_hf(sd, depth=depth, num_heads=num_heads)
+    if arch == "llama":
+        return llama_params_from_hf(
+            sd, depth=depth, num_heads=num_heads, num_kv_heads=num_kv_heads
+        )
+    raise ValueError(f"unknown arch {arch!r} (want gpt2 or llama)")
+
+
+def save_hf_checkpoint(params, path, *, arch: str, depth: int) -> None:
+    """Write tpudist params as an HF-layout ``model.safetensors`` under
+    ``path`` — the hand-off back to the torch/transformers ecosystem
+    (loadable with ``load_state_dict`` on the matching config; pair with
+    the architecture's config.json as needed)."""
+    import os
+
+    from safetensors.numpy import save_file
+
+    if arch == "gpt2":
+        sd = gpt2_params_to_hf(params, depth=depth)
+    elif arch == "llama":
+        sd = llama_params_to_hf(params, depth=depth)
+    else:
+        raise ValueError(f"unknown arch {arch!r} (want gpt2 or llama)")
+    os.makedirs(path, exist_ok=True)
+    save_file(
+        {k: np.ascontiguousarray(v) for k, v in sd.items()},
+        os.path.join(path, "model.safetensors"),
+    )
+
+
 def gpt2_params_to_hf(params, *, depth: int) -> dict:
     """Inverse of :func:`gpt2_params_from_hf`: ``GPT2`` params → a state
     dict loadable by HF ``GPT2LMHeadModel.load_state_dict(strict=False)``

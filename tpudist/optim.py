@@ -34,6 +34,19 @@ def warmup_cosine(
     )
 
 
+def run_schedule(
+    peak_lr: float, *, total_steps: int, warmup_steps: int = 0
+) -> optax.Schedule:
+    """:func:`warmup_cosine` sized to a training run: one optimizer step
+    per loader batch (grad accumulation does not reduce the count), warmup
+    clamped to half the horizon so short runs still decay. The one home
+    for this recipe — both CLI entry points use it."""
+    total = max(total_steps, 1)
+    return warmup_cosine(
+        peak_lr, warmup_steps=min(warmup_steps, total // 2), total_steps=total
+    )
+
+
 def decay_mask(params) -> Any:
     """True for leaves that SHOULD receive weight decay: everything except
     1-D params (biases, LayerNorm/BatchNorm scales and offsets)."""
