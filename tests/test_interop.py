@@ -69,6 +69,7 @@ def test_gpt2_param_tree_matches_model_init():
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_leaves_with_path(ref),
         jax.tree_util.tree_leaves_with_path(params),
+        strict=True,
     ):
         assert np.shape(a) == np.shape(b), (pa, np.shape(a), np.shape(b))
 
@@ -105,9 +106,22 @@ def test_save_hf_checkpoint_roundtrip(tmp_path):
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_leaves_with_path(params),
         jax.tree_util.tree_leaves_with_path(back),
+        strict=True,
     ):
         assert pa == pb
         np.testing.assert_array_equal(np.asarray(a, np.float32), b)
+
+    # the advertised hand-off: config.json + our safetensors must load via
+    # transformers' own from_pretrained (requires safetensors metadata)
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=1, n_head=4
+    )
+    cfg.save_pretrained(tmp_path / "export")
+    hf = transformers.GPT2LMHeadModel.from_pretrained(tmp_path / "export")
+    np.testing.assert_array_equal(
+        hf.state_dict()["transformer.wte.weight"].numpy(),
+        np.asarray(params["wte"], np.float32),
+    )
 
 
 def test_load_hf_state_dict_formats(tmp_path):

@@ -199,6 +199,8 @@ def save_hf_checkpoint(params, path, *, arch: str, depth: int) -> None:
     save_file(
         {k: np.ascontiguousarray(v) for k, v in sd.items()},
         os.path.join(path, "model.safetensors"),
+        # transformers' from_pretrained refuses metadata-less safetensors
+        metadata={"format": "pt"},
     )
 
 
