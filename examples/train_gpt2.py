@@ -71,6 +71,9 @@ def parse_args(argv=None):
     p.add_argument("--rope_theta", default=10000.0, type=float)
     p.add_argument("--tie_embeddings", action="store_true",
                    help="llama: tie the LM head to the embedding")
+    p.add_argument("--scan_layers", action="store_true",
+                   help="llama: nn.scan the depth (one traced layer, params "
+                   "stacked [depth,...]) — compile time O(1) in depth")
     p.add_argument("--vocab_size", default=50257, type=int)
     p.add_argument("--seq_len", default=1024, type=int)
     # data: a flat token file (.npy, or nanoGPT-style raw .bin) or synthetic
@@ -210,16 +213,23 @@ def main(argv=None):
             raise SystemExit("--experts supports the gpt2 arch only")
         if args.dropout:
             raise SystemExit("llama has no dropout (matching the family)")
+        if args.scan_layers and (args.generate or args.init_hf):
+            raise SystemExit(
+                "--scan_layers uses the stacked param layout; --generate/"
+                "--init_hf need the unrolled model"
+            )
         model = Llama(
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
             hidden_dim=args.hidden_dim, depth=args.depth,
             num_heads=args.num_heads,
             num_kv_heads=args.num_kv_heads or None,
             ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
-            tie_embeddings=args.tie_embeddings,
+            tie_embeddings=args.tie_embeddings, scan_layers=args.scan_layers,
             dtype=dtype, attn_impl=args.attn, mesh=mesh,
         )
     else:
+        if args.scan_layers:
+            raise SystemExit("--scan_layers supports the llama arch only")
         model = GPT2(
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
             hidden_dim=args.hidden_dim, depth=args.depth,

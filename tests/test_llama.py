@@ -190,6 +190,58 @@ def test_chunked_ce_matches_full_logits():
         np.testing.assert_allclose(float(got), want, atol=1e-5, rtol=1e-5)
 
 
+def test_scan_layers_matches_unrolled():
+    """nn.scan'd depth == the unrolled loop given the same weights: stack
+    each layer_{i} subtree into the layers/block leading axis."""
+    tokens = _batch(b=2, s=12)["tokens"]
+    unrolled = _tiny(num_kv_heads=2, depth=3)
+    variables = unrolled.init(jax.random.key(5), tokens, train=False)
+    params = variables["params"]
+    want = unrolled.apply(variables, tokens, train=False)
+
+    from flax import linen as nn
+
+    plain = nn.meta.unbox(params)
+    stacked = {
+        k: v for k, v in plain.items() if not k.startswith("layer_")
+    }
+    stacked["layers"] = {
+        "block": jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves),
+            *(plain[f"layer_{i}"] for i in range(3)),
+        )
+    }
+    scan_model = _tiny(num_kv_heads=2, depth=3, scan_layers=True)
+    got = scan_model.apply({"params": stacked}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_scan_layers_tp_sharding_and_training():
+    """Stacked params keep their tensor-parallel metadata (shifted past the
+    depth axis) and the compiled train step runs."""
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=4, tensor=2))
+    model = _tiny(num_kv_heads=2, depth=2, scan_layers=True)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh)
+    spec = tuple(state.params["layers"]["block"]["q_proj"]["kernel"].sharding.spec)
+    assert spec[0] is None and "tensor" in spec, spec  # depth axis unsharded
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    state, metrics = step(state, _batch(b=8))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_scan_layers_decode_rejected():
+    model = _tiny(depth=2, scan_layers=True)
+    with pytest.raises(ValueError, match="decode"):
+        model.init(jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
+                   train=False, decode=True)
+
+
 def test_size_presets():
     assert llama_125m().num_kv_heads == 4
     m = llama2_7b()

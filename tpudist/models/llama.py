@@ -162,6 +162,32 @@ class LlamaBlock(nn.Module):
         return x + y
 
 
+class _CarryBlock(nn.Module):
+    """:class:`LlamaBlock` with the (carry, xs) -> (carry, ys) signature
+    ``nn.scan`` maps over; ``train`` rides as a module field because scan
+    broadcasts call-time kwargs awkwardly."""
+
+    num_heads: int
+    num_kv_heads: int
+    ffn_dim: int
+    train: bool = True
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    rope_theta: float = 10000.0
+    mesh: Any = None
+    norm_eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, _):
+        x = LlamaBlock(
+            self.num_heads, self.num_kv_heads, self.ffn_dim,
+            dtype=self.dtype, attn_impl=self.attn_impl,
+            rope_theta=self.rope_theta, mesh=self.mesh,
+            norm_eps=self.norm_eps, name="block",
+        )(x, train=self.train)
+        return x, None
+
+
 class Llama(nn.Module):
     vocab_size: int = 32000
     max_seq_len: int = 2048
@@ -176,6 +202,14 @@ class Llama(nn.Module):
     tie_embeddings: bool = False
     mesh: Any = None
     norm_eps: float = 1e-5
+    # scan_layers=True runs the depth as ONE nn.scan'd block with params
+    # stacked [depth, ...] — XLA traces/compiles a single layer regardless
+    # of depth (the idiomatic TPU pattern for 32+ layer models; an unrolled
+    # llama2-7b traces 32 copies of the block). Param names move from
+    # layer_{i}/... to layers/... with a leading depth axis; TP metadata is
+    # preserved (the stacked axis stays unsharded). Training/eval only —
+    # decode and the interop converters use the unrolled layout.
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
@@ -191,12 +225,33 @@ class Llama(nn.Module):
             (self.vocab_size, self.hidden_dim), jnp.float32,
         )
         x = embed[tokens].astype(self.dtype)  # RoPE: no position table
-        for i in range(self.depth):
-            x = LlamaBlock(
-                self.num_heads, kv, ffn, dtype=self.dtype,
-                attn_impl=self.attn_impl, rope_theta=self.rope_theta,
-                mesh=self.mesh, norm_eps=self.norm_eps, name=f"layer_{i}",
-            )(x, train=train, decode=decode, max_len=self.max_seq_len)
+        block_cfg = dict(
+            num_heads=self.num_heads, num_kv_heads=kv, ffn_dim=ffn,
+            dtype=self.dtype, attn_impl=self.attn_impl,
+            rope_theta=self.rope_theta, mesh=self.mesh,
+            norm_eps=self.norm_eps,
+        )
+        if self.scan_layers:
+            if decode:
+                raise ValueError(
+                    "scan_layers has no decode path (the KV cache needs "
+                    "per-layer variables); generate with scan_layers=False"
+                )
+            scanned = nn.scan(
+                _CarryBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.depth,
+                # stacked depth axis carries no partition name (unsharded);
+                # the per-layer TENSOR_AXIS metadata shifts right intact
+                metadata_params={nn.PARTITION_NAME: None},
+            )(train=train, **block_cfg, name="layers")
+            x, _ = scanned(x, None)
+        else:
+            for i in range(self.depth):
+                x = LlamaBlock(**block_cfg, name=f"layer_{i}")(
+                    x, train=train, decode=decode, max_len=self.max_seq_len
+                )
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")(x)
         if return_hidden:
             # the chunked-CE path applies the head per sequence chunk so the
