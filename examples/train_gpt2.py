@@ -72,8 +72,9 @@ def parse_args(argv=None):
     p.add_argument("--tie_embeddings", action="store_true",
                    help="llama: tie the LM head to the embedding")
     p.add_argument("--scan_layers", action="store_true",
-                   help="llama: nn.scan the depth (one traced layer, params "
-                   "stacked [depth,...]) — compile time O(1) in depth")
+                   help="nn.scan the depth (one traced layer, params stacked "
+                   "[depth,...]) — compile time O(1) in depth; dense "
+                   "training only")
     p.add_argument("--vocab_size", default=50257, type=int)
     p.add_argument("--seq_len", default=1024, type=int)
     # data: a flat token file (.npy, or nanoGPT-style raw .bin) or synthetic
@@ -201,6 +202,11 @@ def main(argv=None):
             raise SystemExit("--dropout is not supported with --pipe")
         if args.arch != "gpt2":
             raise SystemExit("--pipe supports the gpt2 arch only")
+        if args.scan_layers:
+            raise SystemExit(
+                "--scan_layers is not supported with --pipe (the pipeline "
+                "already stacks blocks over the 'pipe' axis)"
+            )
         model = PipelinedGPT2(
             mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
             max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
@@ -228,13 +234,17 @@ def main(argv=None):
             dtype=dtype, attn_impl=args.attn, mesh=mesh,
         )
     else:
-        if args.scan_layers:
-            raise SystemExit("--scan_layers supports the llama arch only")
+        if args.scan_layers and (args.experts or args.generate or args.init_hf):
+            raise SystemExit(
+                "--scan_layers supports dense training only (no --experts/"
+                "--generate/--init_hf: those need the unrolled layout)"
+            )
         model = GPT2(
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
             hidden_dim=args.hidden_dim, depth=args.depth,
             num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
             num_experts=args.experts, mesh=mesh, dropout=args.dropout,
+            scan_layers=args.scan_layers,
         )
 
     from tpudist.data.lm import TokenWindowLoader

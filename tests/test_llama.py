@@ -191,31 +191,33 @@ def test_chunked_ce_matches_full_logits():
 
 
 def test_scan_layers_matches_unrolled():
-    """nn.scan'd depth == the unrolled loop given the same weights: stack
-    each layer_{i} subtree into the layers/block leading axis."""
+    """nn.scan'd depth == the unrolled loop given the same weights, moved
+    across layouts with stack_llama_layers; unstack inverts it exactly."""
+    from tpudist.models.llama import stack_llama_layers, unstack_llama_layers
+
     tokens = _batch(b=2, s=12)["tokens"]
     unrolled = _tiny(num_kv_heads=2, depth=3)
     variables = unrolled.init(jax.random.key(5), tokens, train=False)
     params = variables["params"]
     want = unrolled.apply(variables, tokens, train=False)
 
-    from flax import linen as nn
-
-    plain = nn.meta.unbox(params)
-    stacked = {
-        k: v for k, v in plain.items() if not k.startswith("layer_")
-    }
-    stacked["layers"] = {
-        "block": jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack(leaves),
-            *(plain[f"layer_{i}"] for i in range(3)),
-        )
-    }
+    stacked = stack_llama_layers(params, depth=3)
     scan_model = _tiny(num_kv_heads=2, depth=3, scan_layers=True)
     got = scan_model.apply({"params": stacked}, tokens, train=False)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
+
+    from flax import linen as nn
+
+    back = unstack_llama_layers(stacked)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(nn.meta.unbox(params)),
+        jax.tree_util.tree_leaves_with_path(back),
+        strict=True,
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_scan_layers_tp_sharding_and_training():

@@ -94,3 +94,39 @@ def test_chunked_rejects_bad_chunk():
         chunked_lm_forward(_model(), chunk=0)
     with pytest.raises(ValueError):
         chunked_lm_forward(_model(), chunk=-256)
+
+
+def test_gpt2_scan_layers_matches_unrolled():
+    """GPT-2's nn.scan'd depth == the unrolled loop given the same weights
+    (moved across layouts with the shared stack_layers converter)."""
+    import jax
+    import numpy as np
+
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.models.lm_utils import stack_layers, unstack_layers
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    tokens = rng.integers(0, 64, (2, 12)).astype(np.int32)
+    unrolled = GPT2(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=3,
+                    num_heads=4)
+    variables = unrolled.init(jax.random.key(6), tokens, train=False)
+    want = unrolled.apply(variables, tokens, train=False)
+
+    stacked = stack_layers(variables["params"], 3, prefix="h_", dest="hs")
+    scan_model = GPT2(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=3,
+                      num_heads=4, scan_layers=True)
+    got = scan_model.apply({"params": stacked}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    # and the inverse restores the unrolled tree exactly
+    from flax import linen as nn
+
+    back = unstack_layers(stacked, prefix="h_", dest="hs")
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(nn.meta.unbox(variables["params"])),
+        jax.tree_util.tree_leaves_with_path(back),
+        strict=True,
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -135,6 +135,27 @@ class Block(nn.Module):
         return x + drop(y)
 
 
+class _CarryBlock(nn.Module):
+    """:class:`Block` with the (carry, xs) -> (carry, ys) signature
+    ``nn.scan`` maps over (``train`` rides as a field; dropout rngs are
+    split per layer by the scan)."""
+
+    num_heads: int
+    train: bool = True
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    mesh: Any = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, _):
+        x = Block(
+            self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
+            mesh=self.mesh, dropout=self.dropout, name="block",
+        )(x, train=self.train)
+        return x, None
+
+
 class GPT2(nn.Module):
     vocab_size: int = 50257
     max_seq_len: int = 1024
@@ -152,6 +173,10 @@ class GPT2(nn.Module):
     capacity_factor: float = 1.25
     mesh: Any = None
     dropout: float = 0.0  # embedding + residual dropout (GPT-2 paper: 0.1)
+    # scan_layers=True runs the depth as ONE nn.scan'd block (params stacked
+    # [depth, ...], one traced layer at any depth — see the Llama field of
+    # the same name). Dense blocks only; decode/MoE use the unrolled layout.
+    scan_layers: bool = False
 
     @property
     def has_aux_loss(self) -> bool:
@@ -185,14 +210,35 @@ class GPT2(nn.Module):
         x = wte[tokens].astype(self.dtype) + pos.astype(self.dtype)
         if self.dropout:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        for i in range(self.depth):
-            moe_here = self.num_experts > 0 and (i % self.moe_every == self.moe_every - 1)
-            x = Block(
-                self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
-                num_experts=self.num_experts if moe_here else 0,
-                moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
-                mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
-            )(x, train=train, decode=decode, max_len=self.max_seq_len)
+        if self.scan_layers:
+            if decode:
+                raise ValueError(
+                    "scan_layers has no decode path (the KV cache needs "
+                    "per-layer variables); generate with scan_layers=False"
+                )
+            if self.num_experts:
+                raise ValueError("scan_layers supports dense blocks only")
+            scanned = nn.scan(
+                _CarryBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.depth,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(
+                num_heads=self.num_heads, train=train, dtype=self.dtype,
+                attn_impl=self.attn_impl, mesh=self.mesh,
+                dropout=self.dropout, name="hs",
+            )
+            x, _ = scanned(x, None)
+        else:
+            for i in range(self.depth):
+                moe_here = self.num_experts > 0 and (i % self.moe_every == self.moe_every - 1)
+                x = Block(
+                    self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
+                    num_experts=self.num_experts if moe_here else 0,
+                    moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
+                    mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
+                )(x, train=train, decode=decode, max_len=self.max_seq_len)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head

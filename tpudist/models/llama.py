@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -269,6 +270,23 @@ class Llama(nn.Module):
             "bsd,vd->bsv", x, head.astype(self.dtype),
             preferred_element_type=jnp.float32,
         )
+
+
+def stack_llama_layers(params, depth: int) -> dict:
+    """Unrolled ``layer_{i}`` params → the ``scan_layers`` layout; lets
+    checkpoints move between layouts (e.g. warm-start a scan model from an
+    HF import). See :func:`tpudist.models.lm_utils.stack_layers`."""
+    from tpudist.models.lm_utils import stack_layers
+
+    return stack_layers(params, depth, prefix="layer_", dest="layers")
+
+
+def unstack_llama_layers(params) -> dict:
+    """``scan_layers`` layout → unrolled ``layer_{i}`` params (the layout
+    decode/generation and the HF exporters use)."""
+    from tpudist.models.lm_utils import unstack_layers
+
+    return unstack_layers(params, prefix="layer_", dest="layers")
 
 
 def llama_125m(**kw) -> Llama:

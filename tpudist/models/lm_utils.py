@@ -12,6 +12,40 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+def stack_layers(params, depth: int, *, prefix: str, dest: str) -> dict:
+    """Unrolled ``{prefix}{i}/...`` params → the ``scan_layers`` layout
+    (``{dest}/block/...`` with a leading depth axis). One implementation
+    for both decoder families (GPT-2: ``h_``/``hs``; Llama:
+    ``layer_``/``layers``)."""
+    plain = nn.meta.unbox(params)
+    found = sorted(k for k in plain if k.startswith(prefix))
+    if len(found) != depth:
+        raise ValueError(
+            f"params hold {len(found)} {prefix}* layers but depth={depth} "
+            "was requested — refusing to silently truncate/misstack"
+        )
+    out = {k: v for k, v in plain.items() if not k.startswith(prefix)}
+    out[dest] = {
+        "block": jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves),
+            *(plain[f"{prefix}{i}"] for i in range(depth)),
+        )
+    }
+    return out
+
+
+def unstack_layers(params, *, prefix: str, dest: str) -> dict:
+    """Inverse of :func:`stack_layers` — back to the unrolled layout that
+    decode/generation and the HF exporters use."""
+    plain = nn.meta.unbox(params)
+    block = plain[dest]["block"]
+    depth = jax.tree_util.tree_leaves(block)[0].shape[0]
+    out = {k: v for k, v in plain.items() if k != dest}
+    for i in range(depth):
+        out[f"{prefix}{i}"] = jax.tree_util.tree_map(lambda a: a[i], block)
+    return out
+
+
 def lm_head_weight(params):
     """The [V, D] output-projection weight of an LM, whichever family:
     GPT-2's tied ``wte``, Llama's untied ``lm_head`` (falling back to its
