@@ -75,6 +75,10 @@ def parse_args(argv=None):
                    help="nn.scan the depth (one traced layer, params stacked "
                    "[depth,...]) — compile time O(1) in depth; dense "
                    "training only")
+    p.add_argument("--remat_layers", action="store_true",
+                   help="with --scan_layers: checkpoint each layer (store "
+                   "boundaries, recompute inside) — the deep-model memory "
+                   "lever")
     p.add_argument("--vocab_size", default=50257, type=int)
     p.add_argument("--seq_len", default=1024, type=int)
     # data: a flat token file (.npy, or nanoGPT-style raw .bin) or synthetic
@@ -202,10 +206,10 @@ def main(argv=None):
             raise SystemExit("--dropout is not supported with --pipe")
         if args.arch != "gpt2":
             raise SystemExit("--pipe supports the gpt2 arch only")
-        if args.scan_layers:
+        if args.scan_layers or args.remat_layers:
             raise SystemExit(
-                "--scan_layers is not supported with --pipe (the pipeline "
-                "already stacks blocks over the 'pipe' axis)"
+                "--scan_layers/--remat_layers are not supported with --pipe "
+                "(the pipeline already stacks blocks over the 'pipe' axis)"
             )
         model = PipelinedGPT2(
             mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
@@ -231,6 +235,7 @@ def main(argv=None):
             num_kv_heads=args.num_kv_heads or None,
             ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
             tie_embeddings=args.tie_embeddings, scan_layers=args.scan_layers,
+            remat_layers=args.remat_layers,
             dtype=dtype, attn_impl=args.attn, mesh=mesh,
         )
     else:
@@ -244,7 +249,7 @@ def main(argv=None):
             hidden_dim=args.hidden_dim, depth=args.depth,
             num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
             num_experts=args.experts, mesh=mesh, dropout=args.dropout,
-            scan_layers=args.scan_layers,
+            scan_layers=args.scan_layers, remat_layers=args.remat_layers,
         )
 
     from tpudist.data.lm import TokenWindowLoader

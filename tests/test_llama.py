@@ -237,6 +237,43 @@ def test_scan_layers_tp_sharding_and_training():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_remat_layers_same_numerics_and_trains():
+    """Per-layer remat changes memory, not math: same loss and same grads
+    as plain scan_layers on a training step."""
+    batch = _batch(b=8)
+    mesh = mesh_lib.create_mesh()
+
+    def one_step(remat_layers):
+        model = _tiny(num_kv_heads=2, depth=2, scan_layers=True,
+                      remat_layers=remat_layers)
+        tx = optax.sgd(0.1)
+        state = create_train_state(model, 0, jnp.zeros((1, 16), jnp.int32),
+                                   tx, mesh)
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", state_sharding=state_shardings_of(state),
+        )
+        state, metrics = step(state, batch)
+        return float(metrics["loss"]), state.params
+
+    loss_plain, params_plain = one_step(False)
+    loss_remat, params_remat = one_step(True)
+    np.testing.assert_allclose(loss_remat, loss_plain, rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_plain),
+        jax.tree_util.tree_leaves(params_remat),
+        strict=True,
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_remat_layers_requires_scan():
+    model = _tiny(depth=2, remat_layers=True)
+    with pytest.raises(ValueError, match="requires scan_layers"):
+        model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                   train=False)
+
+
 def test_scan_layers_decode_rejected():
     model = _tiny(depth=2, scan_layers=True)
     with pytest.raises(ValueError, match="decode"):

@@ -130,3 +130,33 @@ def test_gpt2_scan_layers_matches_unrolled():
     ):
         assert pa == pb
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gpt2_remat_layers_with_dropout_trains():
+    """GPT-2's scan splits a 'dropout' rng through nn.remat — the rng/remat
+    interaction Llama (dropout-free) never exercises."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import (
+        create_train_state, lm_loss, make_train_step, state_shardings_of,
+    )
+
+    mesh = mesh_lib.create_mesh()
+    model = GPT2(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+                 num_heads=4, dropout=0.1, scan_layers=True,
+                 remat_layers=True)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh)
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    rng = np.random.Generator(np.random.PCG64(1))
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    for _ in range(2):
+        state, metrics = step(state, {"tokens": tokens})
+    assert np.isfinite(float(metrics["loss"]))

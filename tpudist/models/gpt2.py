@@ -177,6 +177,9 @@ class GPT2(nn.Module):
     # [depth, ...], one traced layer at any depth — see the Llama field of
     # the same name). Dense blocks only; decode/MoE use the unrolled layout.
     scan_layers: bool = False
+    # remat_layers=True checkpoints each scanned layer (store layer
+    # boundaries, recompute inside) — requires scan_layers
+    remat_layers: bool = False
 
     @property
     def has_aux_loss(self) -> bool:
@@ -218,8 +221,9 @@ class GPT2(nn.Module):
                 )
             if self.num_experts:
                 raise ValueError("scan_layers supports dense blocks only")
+            body = nn.remat(_CarryBlock) if self.remat_layers else _CarryBlock
             scanned = nn.scan(
-                _CarryBlock,
+                body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=self.depth,
@@ -230,6 +234,10 @@ class GPT2(nn.Module):
                 dropout=self.dropout, name="hs",
             )
             x, _ = scanned(x, None)
+        elif self.remat_layers:
+            raise ValueError("remat_layers requires scan_layers=True "
+                             "(use make_train_step(remat=True) to checkpoint "
+                             "an unrolled forward)")
         else:
             for i in range(self.depth):
                 moe_here = self.num_experts > 0 and (i % self.moe_every == self.moe_every - 1)

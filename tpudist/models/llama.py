@@ -26,7 +26,6 @@ from __future__ import annotations
 
 from typing import Any
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -211,6 +210,11 @@ class Llama(nn.Module):
     # preserved (the stacked axis stays unsharded). Training/eval only —
     # decode and the interop converters use the unrolled layout.
     scan_layers: bool = False
+    # remat_layers=True checkpoints each scanned layer: backward stores only
+    # the per-layer boundary activations and recomputes inside the layer —
+    # the scan+remat memory pattern that makes depth-32+ long-sequence
+    # training fit (requires scan_layers)
+    remat_layers: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
@@ -238,8 +242,9 @@ class Llama(nn.Module):
                     "scan_layers has no decode path (the KV cache needs "
                     "per-layer variables); generate with scan_layers=False"
                 )
+            body = nn.remat(_CarryBlock) if self.remat_layers else _CarryBlock
             scanned = nn.scan(
-                _CarryBlock,
+                body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=self.depth,
@@ -248,6 +253,10 @@ class Llama(nn.Module):
                 metadata_params={nn.PARTITION_NAME: None},
             )(train=train, **block_cfg, name="layers")
             x, _ = scanned(x, None)
+        elif self.remat_layers:
+            raise ValueError("remat_layers requires scan_layers=True "
+                             "(use make_train_step(remat=True) to checkpoint "
+                             "an unrolled forward)")
         else:
             for i in range(self.depth):
                 x = LlamaBlock(**block_cfg, name=f"layer_{i}")(
