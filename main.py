@@ -70,6 +70,17 @@ def parse_args(argv=None):
                         help="decoupled (AdamW) weight decay, 1-D params excluded")
     parser.add_argument("--clip_norm", default=None, type=float,
                         help="global gradient-norm clip")
+    def _smoothing_eps(v):
+        v = float(v)
+        if not 0.0 <= v < 1.0:
+            raise argparse.ArgumentTypeError(
+                f"label smoothing must be in [0, 1), got {v}"
+            )
+        return v
+
+    parser.add_argument("--label_smoothing", default=0.0, type=_smoothing_eps,
+                        help="smoothed-CE epsilon in [0,1) (ImageNet recipe: "
+                        "0.1); 0 = the reference's plain CE (main.py:79)")
     parser.add_argument("--grad_accum", default=1, type=int)
     parser.add_argument("--augment", action="store_true",
                         help="standard CIFAR augmentation (crop+flip+"
@@ -181,9 +192,16 @@ def main(argv=None):
         lr, optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
+    if args.label_smoothing:
+        from tpudist.train import smoothed_cross_entropy
+
+        loss_fn = smoothed_cross_entropy(args.label_smoothing)
+    else:
+        from tpudist.train import cross_entropy_loss as loss_fn
     state, losses = fit(
         model, tx, loader,
         epochs=args.epochs, mesh=mesh,
+        loss_fn=loss_fn,
         job_id=args.JobID,
         batch_size=args.batch_size,
         world_size=ctx.world_size,

@@ -160,3 +160,26 @@ def test_gpt2_remat_layers_with_dropout_trains():
     for _ in range(2):
         state, metrics = step(state, {"tokens": tokens})
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_smoothed_ce_reduces_to_plain_at_zero():
+    """Label smoothing (vision recipe): eps=0 is exactly plain CE, eps>0
+    penalizes overconfident one-hot logits."""
+    import jax
+    import numpy as np
+
+    from tpudist.train import cross_entropy_loss, smoothed_cross_entropy
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    logits = jnp.asarray(rng.normal(size=(8, 10)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, 8), jnp.int32)
+    np.testing.assert_allclose(
+        float(smoothed_cross_entropy(0.0)(logits, labels)),
+        float(cross_entropy_loss(logits, labels)),
+        rtol=1e-6,
+    )
+    # eps > 0 penalizes overconfidence: loss on one-hot-perfect logits rises
+    sharp = jnp.where(jax.nn.one_hot(labels, 10) > 0, 50.0, 0.0)
+    assert float(smoothed_cross_entropy(0.1)(sharp, labels)) > float(
+        smoothed_cross_entropy(0.0)(sharp, labels)
+    )

@@ -54,6 +54,19 @@ def cross_entropy_loss(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def smoothed_cross_entropy(smoothing: float):
+    """CE against smoothed targets — the standard ImageNet recipe knob
+    (ε=0.1 for the 76%-top-1 ResNet-50 schedule); ε=0 reduces exactly to
+    :func:`cross_entropy_loss`."""
+
+    def loss_fn(logits, labels):
+        n = logits.shape[-1]
+        targets = optax.smooth_labels(jax.nn.one_hot(labels, n), smoothing)
+        return optax.softmax_cross_entropy(logits, targets).mean()
+
+    return loss_fn
+
+
 def lm_loss(logits, tokens):
     """Next-token CE for the GPT-2 config: predict tokens[1:] from tokens[:-1]."""
     return optax.softmax_cross_entropy_with_integer_labels(
