@@ -97,7 +97,12 @@ def parse_args(argv=None):
     p.add_argument("--experts", default=0, type=int, help="MoE experts (0=dense)")
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
-    p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring", "ulysses", "ulysses_flash"])
+    p.add_argument("--attn", default="xla",
+                   choices=["auto", "xla", "flash", "ring", "ulysses",
+                            "ulysses_flash"],
+                   help="auto picks by context length: XLA's fused attention "
+                   "wins below ~2k (measured ~78k vs ~57k tok/s at 1024 on "
+                   "v5e), the flash kernel wins beyond (~14x at 8k)")
     p.add_argument("--init_hf", default=None, type=str,
                    help="warm-start from a LOCAL HF checkpoint dir/file "
                    "(*.safetensors or pytorch_model*.bin) converted via "
@@ -138,6 +143,8 @@ def token_source(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.attn == "auto":
+        args.attn = "xla" if args.seq_len < 2048 else "flash"
     if os.environ.get("TPUDIST_FORCE_CPU"):
         import jax
 

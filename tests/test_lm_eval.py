@@ -202,4 +202,3 @@ def test_muon_trains_gpt2_step():
     assert np.isfinite(float(metrics["loss"]))
     after = np.asarray(state.params["h_0"]["qkv"]["kernel"])
     assert not np.array_equal(before, after)
-

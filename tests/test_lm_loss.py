@@ -165,9 +165,6 @@ def test_gpt2_remat_layers_with_dropout_trains():
 def test_smoothed_ce_reduces_to_plain_at_zero():
     """Label smoothing (vision recipe): eps=0 is exactly plain CE, eps>0
     penalizes overconfident one-hot logits."""
-    import jax
-    import numpy as np
-
     from tpudist.train import cross_entropy_loss, smoothed_cross_entropy
 
     rng = np.random.Generator(np.random.PCG64(0))
