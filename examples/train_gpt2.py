@@ -16,7 +16,8 @@ Parallelism knobs compose on the named mesh:
     --tensor 4             Megatron TP over 'tensor'
     --pipe 4 --num_micro 8 GPipe over 'pipe' (stacked blocks)
     --cp 4 --attn ring     ring-attention context parallelism over 'seq'
-    --experts 8            MoE every other block, experts over 'expert'
+    --experts 8            MoE blocks (every other for gpt2, every for
+                           llama/Mixtral-style), experts over 'expert'
 
 Multi-host works exactly like main.py: ``python -m tpudist.launch ...``.
 """
@@ -173,6 +174,10 @@ def main(argv=None):
             "--eval/--generate support the non-cp, non-pipe paths; rerun "
             "them separately without --cp/--pipe"
         )
+    if args.experts and args.init_hf:
+        # HF checkpoints are dense; an MoE model's per-block moe/router
+        # subtrees have no source weights — fail fast, not mid-warm-start
+        raise SystemExit("--init_hf converts dense checkpoints only")
     if args.generate and args.generate >= args.seq_len:
         raise SystemExit(
             f"--generate {args.generate} must be < --seq_len {args.seq_len} "
@@ -226,14 +231,12 @@ def main(argv=None):
     elif args.arch == "llama":
         from tpudist.models.llama import Llama
 
-        if args.experts:
-            raise SystemExit("--experts supports the gpt2 arch only")
         if args.dropout:
             raise SystemExit("llama has no dropout (matching the family)")
-        if args.scan_layers and (args.generate or args.init_hf):
+        if args.scan_layers and (args.generate or args.init_hf or args.experts):
             raise SystemExit(
-                "--scan_layers uses the stacked param layout; --generate/"
-                "--init_hf need the unrolled model"
+                "--scan_layers uses the stacked dense layout; --generate/"
+                "--init_hf/--experts need the unrolled model"
             )
         model = Llama(
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
@@ -243,6 +246,7 @@ def main(argv=None):
             ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
             tie_embeddings=args.tie_embeddings, scan_layers=args.scan_layers,
             remat_layers=args.remat_layers,
+            num_experts=args.experts,  # Mixtral-style SwiGLU experts
             dtype=dtype, attn_impl=args.attn, mesh=mesh,
         )
     else:
@@ -287,7 +291,7 @@ def main(argv=None):
         from tpudist.models.gpt2 import chunked_lm_forward
 
         if args.pipe > 1 or args.experts:
-            raise SystemExit("--chunked_ce supports the dense GPT2 path only")
+            raise SystemExit("--chunked_ce supports the dense models only")
         forward_loss = chunked_lm_forward(model, chunk=args.chunked_ce)
 
     batch_spec = None

@@ -281,6 +281,49 @@ def test_scan_layers_decode_rejected():
                    train=False, decode=True)
 
 
+def test_moe_single_expert_equals_dense_swiglu():
+    """A 1-expert top-1 Mixtral block with ample capacity IS the dense
+    SwiGLU MLP (gate weight = softmax over one expert = 1): transplant the
+    expert weights into a dense block and compare outputs."""
+    tokens = _batch(b=2, s=8)["tokens"]
+    moe = _tiny(num_experts=1, moe_top_k=1, capacity_factor=4.0, depth=1)
+    variables = moe.init(jax.random.key(3), tokens, train=False)
+    out_moe = moe.apply(variables, tokens, train=False)
+
+    from flax import linen as nn
+
+    p = nn.meta.unbox(variables["params"])
+    layer = dict(p["layer_0"])
+    expert = layer.pop("moe")
+    layer["gate_proj"] = {"kernel": expert["w_gate"][0]}
+    layer["up_proj"] = {"kernel": expert["w_up"][0]}
+    layer["down_proj"] = {"kernel": expert["w_down"][0]}
+    dense_params = {**p, "layer_0": layer}
+    dense = _tiny(depth=1)
+    out_dense = dense.apply({"params": dense_params}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_moe), np.asarray(out_dense), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_moe_trains_over_expert_axis():
+    """Mixtral-style Llama trains a step on a data x expert mesh with the
+    expert FFNs expert-sharded and the aux loss included."""
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=4, expert=2))
+    model = _tiny(num_experts=2, moe_top_k=2, mesh=mesh, depth=2)
+    assert model.has_aux_loss
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((8, 16), jnp.int32), tx, mesh)
+    spec = state.params["layer_0"]["moe"]["w_gate"].sharding.spec
+    assert "expert" in spec, spec
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    state, metrics = step(state, _batch(b=8))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_size_presets():
     assert llama_125m().num_kv_heads == 4
     m = llama2_7b()

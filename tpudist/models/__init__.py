@@ -7,10 +7,12 @@ from tpudist.models.resnet import (
 )
 from tpudist.models.vit import ViT, vit_b16
 from tpudist.models.gpt2 import GPT2, gpt2_124m, gpt2_medium, gpt2_large
-from tpudist.models.llama import Llama, llama_125m, llama2_7b, llama3_8b
+from tpudist.models.llama import (
+    Llama, llama_125m, llama2_7b, llama3_8b, mixtral_8x7b,
+)
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "ViT", "vit_b16", "GPT2", "gpt2_124m", "gpt2_medium", "gpt2_large",
-    "Llama", "llama_125m", "llama2_7b", "llama3_8b",
+    "Llama", "llama_125m", "llama2_7b", "llama3_8b", "mixtral_8x7b",
 ]

@@ -59,6 +59,11 @@ class LlamaBlock(nn.Module):
     rope_theta: float = 10000.0
     mesh: Any = None
     norm_eps: float = 1e-5
+    # num_experts > 0 swaps the SwiGLU MLP for a Mixtral-style MoE of
+    # SwiGLU experts (tpudist.parallel.ep), expert-sharded over 'expert'
+    num_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
@@ -148,17 +153,27 @@ class LlamaBlock(nn.Module):
 
         y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype,
                        name="mlp_norm")(x)
-        # SwiGLU: silu(gate) * up, both column-parallel; down row-parallel
-        gate = nn.Dense(self.ffn_dim, use_bias=False, dtype=self.dtype,
-                        name="gate_proj",
-                        kernel_init=_partitioned(dense_init, None, TENSOR_AXIS))(y)
-        up = nn.Dense(self.ffn_dim, use_bias=False, dtype=self.dtype,
-                      name="up_proj",
-                      kernel_init=_partitioned(dense_init, None, TENSOR_AXIS))(y)
-        y = nn.Dense(d, use_bias=False, dtype=self.dtype, name="down_proj",
-                     kernel_init=_partitioned(dense_init, TENSOR_AXIS, None))(
-            nn.silu(gate) * up
-        )
+        if self.num_experts > 0:
+            from tpudist.parallel.ep import MoEMlp
+
+            y = MoEMlp(
+                num_experts=self.num_experts, top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor,
+                ffn_dim=self.ffn_dim, expert_act="swiglu",
+                dtype=self.dtype, mesh=self.mesh, name="moe",
+            )(y)
+        else:
+            # SwiGLU: silu(gate)·up, both column-parallel; down row-parallel
+            gate = nn.Dense(self.ffn_dim, use_bias=False, dtype=self.dtype,
+                            name="gate_proj",
+                            kernel_init=_partitioned(dense_init, None, TENSOR_AXIS))(y)
+            up = nn.Dense(self.ffn_dim, use_bias=False, dtype=self.dtype,
+                          name="up_proj",
+                          kernel_init=_partitioned(dense_init, None, TENSOR_AXIS))(y)
+            y = nn.Dense(d, use_bias=False, dtype=self.dtype, name="down_proj",
+                         kernel_init=_partitioned(dense_init, TENSOR_AXIS, None))(
+                nn.silu(gate) * up
+            )
         return x + y
 
 
@@ -215,6 +230,17 @@ class Llama(nn.Module):
     # the scan+remat memory pattern that makes depth-32+ long-sequence
     # training fit (requires scan_layers)
     remat_layers: bool = False
+    # num_experts > 0: every moe_every-th block is Mixtral-style MoE (SwiGLU
+    # experts over the 'expert' mesh axis, tpudist.parallel.ep); aux
+    # load-balance losses are sowed and added by the train step
+    num_experts: int = 0
+    moe_every: int = 1  # Mixtral: every block is MoE
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+
+    @property
+    def has_aux_loss(self) -> bool:
+        return self.num_experts > 0
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
@@ -242,6 +268,8 @@ class Llama(nn.Module):
                     "scan_layers has no decode path (the KV cache needs "
                     "per-layer variables); generate with scan_layers=False"
                 )
+            if self.num_experts:
+                raise ValueError("scan_layers supports dense blocks only")
             body = nn.remat(_CarryBlock) if self.remat_layers else _CarryBlock
             scanned = nn.scan(
                 body,
@@ -259,9 +287,16 @@ class Llama(nn.Module):
                              "an unrolled forward)")
         else:
             for i in range(self.depth):
-                x = LlamaBlock(**block_cfg, name=f"layer_{i}")(
-                    x, train=train, decode=decode, max_len=self.max_seq_len
+                moe_here = self.num_experts > 0 and (
+                    i % self.moe_every == self.moe_every - 1
                 )
+                x = LlamaBlock(
+                    **block_cfg,
+                    num_experts=self.num_experts if moe_here else 0,
+                    moe_top_k=self.moe_top_k,
+                    capacity_factor=self.capacity_factor,
+                    name=f"layer_{i}",
+                )(x, train=train, decode=decode, max_len=self.max_seq_len)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")(x)
         if return_hidden:
             # the chunked-CE path applies the head per sequence chunk so the
@@ -311,6 +346,22 @@ def llama2_7b(**kw) -> Llama:
     kw.setdefault("num_heads", 32)
     kw.setdefault("ffn_dim", 11008)
     kw.setdefault("max_seq_len", 4096)
+    return Llama(**kw)
+
+
+def mixtral_8x7b(**kw) -> Llama:
+    """Mixtral-8x7B geometry: Llama-7B trunk, every block an 8-expert
+    top-2 SwiGLU MoE, GQA 32/8, 32k rope theta 1e6."""
+    kw.setdefault("hidden_dim", 4096)
+    kw.setdefault("depth", 32)
+    kw.setdefault("num_heads", 32)
+    kw.setdefault("num_kv_heads", 8)
+    kw.setdefault("ffn_dim", 14336)
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("rope_theta", 1e6)
+    kw.setdefault("max_seq_len", 32768)
+    kw.setdefault("num_experts", 8)
+    kw.setdefault("moe_top_k", 2)
     return Llama(**kw)
 
 

@@ -117,6 +117,10 @@ class MoEMlp(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     mlp_ratio: int = 4
+    ffn_dim: int | None = None  # overrides mlp_ratio·d when set
+    # "gelu": GPT-2-style single-FFN experts; "swiglu": Mixtral-style
+    # gated experts (silu(x·w_gate)·(x·w_up))·w_down
+    expert_act: str = "gelu"
     aux_loss_weight: float = 0.01
     num_groups: int = 0  # 0 → one group per batch row
     dtype: Any = jnp.float32
@@ -126,7 +130,7 @@ class MoEMlp(nn.Module):
     def __call__(self, x):
         b, s, d = x.shape
         E = self.num_experts
-        ff = self.mlp_ratio * d
+        ff = self.ffn_dim or self.mlp_ratio * d
         G = self.num_groups or b
         T = b * s
         if T % G:
@@ -152,20 +156,15 @@ class MoEMlp(nn.Module):
             reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.zeros((), jnp.float32),
         )
 
-        w1 = self.param(
-            "w1",
-            nn.with_partitioning(
-                nn.initializers.lecun_normal(), (EXPERT_AXIS, None, TENSOR_AXIS)
-            ),
-            (E, d, ff), jnp.float32,
-        )
-        w2 = self.param(
-            "w2",
-            nn.with_partitioning(
-                nn.initializers.lecun_normal(), (EXPERT_AXIS, TENSOR_AXIS, None)
-            ),
-            (E, ff, d), jnp.float32,
-        )
+        def ew(name, shape, spec):
+            return self.param(
+                name,
+                nn.with_partitioning(nn.initializers.lecun_normal(), spec),
+                shape, jnp.float32,
+            )
+
+        col = (EXPERT_AXIS, None, TENSOR_AXIS)
+        row = (EXPERT_AXIS, TENSOR_AXIS, None)
 
         # tokens (data-sharded groups) → expert slots: GSPMD turns the
         # sharding jump into the all-to-all
@@ -173,9 +172,22 @@ class MoEMlp(nn.Module):
             "gtec,gtd->gecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
         )
         slots = self._constrain(slots)
-        h = jnp.einsum("gecd,edf->gecf", slots, w1.astype(self.dtype))
-        h = nn.gelu(h)
-        out = jnp.einsum("gecf,efd->gecd", h, w2.astype(self.dtype))
+        if self.expert_act == "swiglu":
+            wg = ew("w_gate", (E, d, ff), col)
+            wu = ew("w_up", (E, d, ff), col)
+            wd = ew("w_down", (E, ff, d), row)
+            h = nn.silu(
+                jnp.einsum("gecd,edf->gecf", slots, wg.astype(self.dtype))
+            ) * jnp.einsum("gecd,edf->gecf", slots, wu.astype(self.dtype))
+            out = jnp.einsum("gecf,efd->gecd", h, wd.astype(self.dtype))
+        elif self.expert_act == "gelu":
+            w1 = ew("w1", (E, d, ff), col)
+            w2 = ew("w2", (E, ff, d), row)
+            h = jnp.einsum("gecd,edf->gecf", slots, w1.astype(self.dtype))
+            h = nn.gelu(h)
+            out = jnp.einsum("gecf,efd->gecd", h, w2.astype(self.dtype))
+        else:
+            raise ValueError(f"unknown expert_act {self.expert_act!r}")
         out = self._constrain(out)
         # expert slots → tokens (the reverse all-to-all), gate-weighted
         y = jnp.einsum("gtec,gecd->gtd", combine.astype(self.dtype), out)
