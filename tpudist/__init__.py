@@ -27,7 +27,7 @@ from tpudist.distributed import DistributedContext, init_from_env, reduce_loss
 from tpudist.data.sampler import DistributedSampler
 from tpudist.store import TCPStore
 from tpudist.amp import Policy, policy_for, skip_nonfinite
-from tpudist.optim import make_optimizer, warmup_cosine
+from tpudist.optim import make_optimizer, run_schedule, warmup_cosine
 
 __version__ = "0.1.0"
 
@@ -45,6 +45,7 @@ __all__ = [
     "policy_for",
     "skip_nonfinite",
     "make_optimizer",
+    "run_schedule",
     "warmup_cosine",
     "__version__",
 ]
