@@ -89,3 +89,43 @@ def test_eval_transform_matches_train_stats():
     # the eval transform runs as ONE fused affine (x·1/(255σ) − μ/σ); the
     # reassociation differs from (x/255 − μ)/σ by float-epsilon only
     np.testing.assert_allclose(ev["image"], want, rtol=1e-4, atol=1e-6)
+
+
+def test_device_normalize_matches_host_affine():
+    """device_normalize (in-graph) computes the same affine as the host
+    to_tensor_normalize, so a loader can switch to shipping uint8 + device
+    transform without changing the numbers."""
+    from tpudist.data.transforms import device_normalize, to_tensor_normalize
+
+    batch = _batch()
+    host = to_tensor_normalize(CIFAR10_MEAN, CIFAR10_STD)(batch)["image"]
+    dev = np.asarray(device_normalize(CIFAR10_MEAN, CIFAR10_STD)(batch["image"]))
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_trains_on_uint8_batches_with_device_transform():
+    """transform=None loader (raw uint8 over the wire) + in-graph
+    device_normalize — the staging-bandwidth-lean input path."""
+    import jax.numpy as jnp
+    import optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.data.loader import DataLoader
+    from tpudist.data.transforms import device_normalize
+    from tpudist.models import resnet18
+    from tpudist.train import create_train_state, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    data = _batch(32)
+    loader = DataLoader(data, 16, transform=None)
+    model = resnet18(num_classes=10, small_inputs=True)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
+    step = make_train_step(
+        model, tx, mesh,
+        input_transform=device_normalize(CIFAR10_MEAN, CIFAR10_STD),
+    )
+    for batch in loader:
+        assert batch["image"].dtype == np.uint8
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))

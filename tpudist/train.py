@@ -149,8 +149,16 @@ def make_train_step(
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
     dropout_seed: int = 0,
+    input_transform: Callable | None = None,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
+
+    ``input_transform``: optional in-graph function applied to
+    ``batch[input_key]`` before the model — e.g.
+    :func:`tpudist.data.transforms.device_normalize`, which lets the loader
+    ship uint8 pixels (4× less host→device traffic and host float work than
+    staging float32) and runs the ToTensor+normalize affine on device, where
+    XLA fuses it into the first conv's input read.
 
     ``forward_loss``: optional fused ``(params, batch_stats, batch) →
     (loss, new_stats)`` replacing the default logits+loss_fn composition —
@@ -196,6 +204,8 @@ def make_train_step(
         variables = {"params": params, "batch_stats": batch_stats}
         has_stats = len(batch_stats) > 0
         inputs = batch[input_key]
+        if input_transform is not None:
+            inputs = input_transform(inputs)
         mutable = (["batch_stats"] if has_stats else []) + (
             ["losses"] if wants_aux else []
         )
@@ -327,6 +337,7 @@ def fit(
     remat: bool = False,
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
+    input_transform: Callable | None = None,
     profile: bool = True,
     prefetch_depth: int = 2,
     log_dir: str = ".",
@@ -400,6 +411,7 @@ def fit(
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
         forward_loss=forward_loss, dropout_seed=seed,
+        input_transform=input_transform,
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
         # here would all-gather a TP model's params on the first step
@@ -469,6 +481,26 @@ def fit(
             print("Start")
             global_step = start_step
             logger.start_timer()
+
+            # one-step-delayed metric resolution: step k's scalar loss is
+            # FETCHED while step k+1 executes (copy_to_host_async starts the
+            # D2H as soon as the value exists). A synchronous per-step fetch
+            # would insert one host↔device round trip into every step — fine
+            # on a local PCIe attach (~0.1 ms), a throughput cliff on a
+            # remote/tunnel attach (~100 ms RTT measured). One step stays in
+            # flight, which also throttles dispatch to the device rate. Rows
+            # land in the TSV in step order, one iteration later; the logged
+            # duration is the inter-step interval (the sustained rate the
+            # reference's clock measures, /root/reference/main.py:95-111).
+            pending = None  # (global_step, epoch, batch_idx, start_time, loss)
+
+            def resolve(now):
+                g, pe, pidx, pstart, dev_loss = pending
+                loss_value = float(dev_loss)
+                losses.append(loss_value)
+                logger.log_step(g, loss_value, now - pstart)
+                logger.print_progress(pe, pidx, loss_value)
+
             for e in range(start_epoch, epochs):
                 if hasattr(train_loader, "sampler"):
                     train_loader.sampler.set_epoch(e)
@@ -494,13 +526,17 @@ def fit(
                     start = time.time()
                     global_step += 1
                     state, metrics = step(state, batch)
-                    loss_value = float(metrics["loss"])  # syncs the step
-                    losses.append(loss_value)
-                    logger.log_step(global_step, loss_value, time.time() - start)
-                    logger.print_progress(e, idx, loss_value)
+                    loss_dev = metrics["loss"]
+                    loss_dev.copy_to_host_async()
+                    if pending is not None:
+                        resolve(start)
+                    pending = (global_step, e, idx, start, loss_dev)
                     p.step()
                     if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
                         ckpt.save(state)
+            if pending is not None:
+                resolve(time.time())
+                pending = None
             if ckpt and global_step > start_step:
                 ckpt.save(state)
     finally:
