@@ -49,7 +49,11 @@ def parse_args(argv=None):
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "vit_b16", "gpt2"])
     parser.add_argument("--dataset", default="cifar100",
-                        choices=["cifar10", "cifar100", "synthetic", "imagenet"])
+                        choices=["cifar10", "cifar100", "synthetic", "imagenet",
+                                 "digits"],
+                        help="digits = sklearn's bundled real handwritten-"
+                        "digit images (for egress-free convergence runs, "
+                        "tpudist/data/digits.py)")
     parser.add_argument("--data_root", default="dataset", type=str,
                         help="CIFAR cache dir, or for --dataset imagenet an "
                         "image-folder tree with train/ and val/ class subdirs")
@@ -158,6 +162,10 @@ def main(argv=None):
         # dataset's class count — the reference does not adapt it (main.py:40)
         if args.dataset == "synthetic":
             data = synthetic_cifar(args.synthetic_size, num_classes=100)
+        elif args.dataset == "digits":
+            from tpudist.data.digits import load_digits_dataset
+
+            data = load_digits_dataset(train=True)
         else:
             data = load_cifar(args.data_root, dataset=args.dataset, train=True)
         sampler = DistributedSampler(
@@ -234,6 +242,10 @@ def main(argv=None):
         else:
             if args.dataset == "synthetic":
                 val = synthetic_cifar(args.synthetic_size // 4 or 1, num_classes=100)
+            elif args.dataset == "digits":
+                from tpudist.data.digits import load_digits_dataset
+
+                val = load_digits_dataset(train=False)
             else:
                 val = load_cifar(args.data_root, dataset=args.dataset, train=False)
             # drop_remainder=False + evaluate's pad-and-mask scores the FULL

@@ -33,6 +33,11 @@ _STATS = {
     # synthetic mimics the 100-class set (main.py --dataset synthetic)
     "synthetic": (CIFAR100_MEAN, CIFAR100_STD),
     "imagenet": (IMAGENET_MEAN, IMAGENET_STD),
+    # sklearn digits (tpudist/data/digits.py), stats of the 0.8 train split
+    "digits": (
+        np.array([0.3053, 0.3053, 0.3053], np.float32),
+        np.array([0.3763, 0.3763, 0.3763], np.float32),
+    ),
 }
 
 
