@@ -206,62 +206,66 @@ def main(argv=None):
     )
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
 
-    if args.pipe > 1:
-        # PipelinedGPT2 builds its blocks with tp=False (shard_map manual
-        # mesh), so tensor metadata would be silently inert — reject rather
-        # than mislead
-        if args.experts or args.attn in ("ring", "ulysses", "ulysses_flash") or args.tensor > 1:
-            raise SystemExit(
-                "--pipe composes with data parallelism only (stacked blocks)"
+    def build_model(scan_layers: bool, remat_layers: bool):
+        """Model per the CLI flags; the scan/remat layout is a parameter so
+        the remote-compile fallback below can rebuild unrolled."""
+        if args.pipe > 1:
+            # PipelinedGPT2 builds its blocks with tp=False (shard_map manual
+            # mesh), so tensor metadata would be silently inert — reject
+            # rather than mislead
+            if args.experts or args.attn in ("ring", "ulysses", "ulysses_flash") or args.tensor > 1:
+                raise SystemExit(
+                    "--pipe composes with data parallelism only (stacked blocks)"
+                )
+            if args.dropout:
+                raise SystemExit("--dropout is not supported with --pipe")
+            if args.arch != "gpt2":
+                raise SystemExit("--pipe supports the gpt2 arch only")
+            if args.scan_layers or args.remat_layers:
+                raise SystemExit(
+                    "--scan_layers/--remat_layers are not supported with --pipe "
+                    "(the pipeline already stacks blocks over the 'pipe' axis)"
+                )
+            return PipelinedGPT2(
+                mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
+                max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
+                depth=args.depth, num_heads=args.num_heads, dtype=dtype,
             )
-        if args.dropout:
-            raise SystemExit("--dropout is not supported with --pipe")
-        if args.arch != "gpt2":
-            raise SystemExit("--pipe supports the gpt2 arch only")
-        if args.scan_layers or args.remat_layers:
-            raise SystemExit(
-                "--scan_layers/--remat_layers are not supported with --pipe "
-                "(the pipeline already stacks blocks over the 'pipe' axis)"
-            )
-        model = PipelinedGPT2(
-            mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
-            max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
-            depth=args.depth, num_heads=args.num_heads, dtype=dtype,
-        )
-    elif args.arch == "llama":
-        from tpudist.models.llama import Llama
+        if args.arch == "llama":
+            from tpudist.models.llama import Llama
 
-        if args.dropout:
-            raise SystemExit("llama has no dropout (matching the family)")
-        if args.scan_layers and (args.generate or args.init_hf or args.experts):
-            raise SystemExit(
-                "--scan_layers uses the stacked dense layout; --generate/"
-                "--init_hf/--experts need the unrolled model"
+            if args.dropout:
+                raise SystemExit("llama has no dropout (matching the family)")
+            if args.scan_layers and (args.generate or args.init_hf or args.experts):
+                raise SystemExit(
+                    "--scan_layers uses the stacked dense layout; --generate/"
+                    "--init_hf/--experts need the unrolled model"
+                )
+            return Llama(
+                vocab_size=args.vocab_size, max_seq_len=args.seq_len,
+                hidden_dim=args.hidden_dim, depth=args.depth,
+                num_heads=args.num_heads,
+                num_kv_heads=args.num_kv_heads or None,
+                ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
+                tie_embeddings=args.tie_embeddings, scan_layers=scan_layers,
+                remat_layers=remat_layers,
+                num_experts=args.experts,  # Mixtral-style SwiGLU experts
+                dtype=dtype, attn_impl=args.attn, mesh=mesh,
             )
-        model = Llama(
-            vocab_size=args.vocab_size, max_seq_len=args.seq_len,
-            hidden_dim=args.hidden_dim, depth=args.depth,
-            num_heads=args.num_heads,
-            num_kv_heads=args.num_kv_heads or None,
-            ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
-            tie_embeddings=args.tie_embeddings, scan_layers=args.scan_layers,
-            remat_layers=args.remat_layers,
-            num_experts=args.experts,  # Mixtral-style SwiGLU experts
-            dtype=dtype, attn_impl=args.attn, mesh=mesh,
-        )
-    else:
         if args.scan_layers and (args.experts or args.generate or args.init_hf):
             raise SystemExit(
                 "--scan_layers supports dense training only (no --experts/"
                 "--generate/--init_hf: those need the unrolled layout)"
             )
-        model = GPT2(
+        return GPT2(
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
             hidden_dim=args.hidden_dim, depth=args.depth,
             num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
             num_experts=args.experts, mesh=mesh, dropout=args.dropout,
-            scan_layers=args.scan_layers, remat_layers=args.remat_layers,
+            scan_layers=scan_layers, remat_layers=remat_layers,
         )
+
+    model = build_model(args.scan_layers, args.remat_layers)
 
     from tpudist.data.lm import TokenWindowLoader
 
@@ -286,13 +290,16 @@ def main(argv=None):
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
 
-    forward_loss = None
-    if args.chunked_ce:
+    def build_forward_loss(mdl):
+        if not args.chunked_ce:
+            return None
         from tpudist.models.gpt2 import chunked_lm_forward
 
         if args.pipe > 1 or args.experts:
             raise SystemExit("--chunked_ce supports the dense models only")
-        forward_loss = chunked_lm_forward(model, chunk=args.chunked_ce)
+        return chunked_lm_forward(mdl, chunk=args.chunked_ce)
+
+    forward_loss = build_forward_loss(model)
 
     batch_spec = None
     if args.cp > 1:
@@ -323,21 +330,74 @@ def main(argv=None):
     # world = one replica per GPU); model-parallel axes don't multiply it
     dp_size = mesh_lib.data_parallel_size(mesh)
 
+    def run_fit(mdl, fwd_loss, remat):
+        if os.environ.get("TPUDIST_TEST_FAIL_SCAN_COMPILE") and getattr(
+            mdl, "scan_layers", False
+        ):
+            # test hook: simulate the tunnel's compile failure so the
+            # fallback path below is exercisable without a remote TPU
+            raise RuntimeError(
+                "remote_compile: HTTP 500 (injected by "
+                "TPUDIST_TEST_FAIL_SCAN_COMPILE)"
+            )
+        return fit(
+            mdl, tx, loader,
+            epochs=args.epochs, mesh=mesh,
+            job_id=args.JobID, batch_size=args.batch_size,
+            world_size=dp_size, global_rank=ctx.process_index,
+            loss_fn=lm_loss, input_key="tokens", label_key="tokens",
+            grad_accum=args.grad_accum, remat=remat,
+            batch_spec=batch_spec, forward_loss=fwd_loss,
+            profile=not args.no_profiler, log_dir=args.log_dir,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=not args.no_resume,
+            init_params=init_params,
+        )
+
     t0 = time.time()
-    state, losses = fit(
-        model, tx, loader,
-        epochs=args.epochs, mesh=mesh,
-        job_id=args.JobID, batch_size=args.batch_size,
-        world_size=dp_size, global_rank=ctx.process_index,
-        loss_fn=lm_loss, input_key="tokens", label_key="tokens",
-        grad_accum=args.grad_accum, remat=args.remat,
-        batch_spec=batch_spec, forward_loss=forward_loss,
-        profile=not args.no_profiler, log_dir=args.log_dir,
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        resume=not args.no_resume,
-        init_params=init_params,
-    )
+    try:
+        state, losses = run_fit(model, forward_loss, args.remat)
+    except Exception as e:
+        # known environment limit: a REMOTE-compile TPU attach (axon-style
+        # tunnel) can 500 compiling the nn.scan'd step at larger shapes
+        # (docs/LM_TRAINING.md §3.6). Infra-shaped failures on a scanned
+        # model retry with the unrolled layout (remat_layers degrades to
+        # whole-forward remat to keep the memory intent); anything else
+        # re-raises.
+        compile_infra = any(
+            s in str(e)
+            for s in ("remote_compile", "tpu_compile_helper", "HTTP 5")
+        )
+        if not (args.scan_layers and compile_infra):
+            raise
+        if args.checkpoint_dir:
+            from tpudist.checkpoint import latest_step
+
+            if latest_step(args.checkpoint_dir) is not None:
+                # saved checkpoints hold the scan layout's stacked 'layers'
+                # tree; silently resuming them into an unrolled rebuild
+                # would crash (or mix runs). Convert explicitly instead.
+                raise RuntimeError(
+                    "remote compile of the scanned step failed after "
+                    f"checkpoints were written to {args.checkpoint_dir}; "
+                    "not auto-falling-back across layouts. Convert with "
+                    "tpudist.models.lm_utils.unstack_layers and rerun "
+                    "without --scan_layers (docs/LM_TRAINING.md §3.6)."
+                ) from e
+        print(
+            "warning: remote compile of the nn.scan'd train step failed "
+            f"({e}); retrying with the unrolled layer layout "
+            "(docs/LM_TRAINING.md §3.6). Checkpoints from a previous "
+            "scan-layout run need tpudist.models.lm_utils.unstack_layers.",
+            file=sys.stderr,
+        )
+        model = build_model(False, False)
+        forward_loss = build_forward_loss(model)
+        t0 = time.time()
+        state, losses = run_fit(
+            model, forward_loss, args.remat or args.remat_layers
+        )
     wall = time.time() - t0
     n_steps = len(losses)
     if n_steps and ctx.process_index == 0:

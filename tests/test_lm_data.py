@@ -127,3 +127,57 @@ def test_train_gpt2_example_runs_with_bin_tokens(tmp_path):
         "--log_dir", str(tmp_path), "--warmup_steps", "2",
     ])
     assert len(losses) > 0 and np.isfinite(losses).all()
+
+
+def test_train_gpt2_scan_compile_fallback(tmp_path, monkeypatch, capsys):
+    """A remote-compile infra failure on the nn.scan'd step retries with the
+    unrolled layout instead of crashing (the documented axon-tunnel limit,
+    docs/LM_TRAINING.md §3.6); the injection hook simulates the 500."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+    import train_gpt2
+
+    monkeypatch.setenv("TPUDIST_TEST_FAIL_SCAN_COMPILE", "1")
+    rng = np.random.Generator(np.random.PCG64(4))
+    binf = tmp_path / "corpus.bin"
+    rng.integers(0, 256, 40_000).astype(np.uint16).tofile(binf)
+    state, losses = train_gpt2.main([
+        "--tokens", str(binf), "--vocab_size", "256", "--seq_len", "64",
+        "--batch_size", "1", "--hidden_dim", "32", "--depth", "2",
+        "--num_heads", "2", "--epochs", "1", "--no_profiler",
+        "--scan_layers", "--remat_layers",
+        "--log_dir", str(tmp_path), "--JobID", "Fallback",
+    ])
+    assert len(losses) > 0 and np.isfinite(losses).all()
+    # the unrolled rebuild has per-block params, not a stacked 'layers' tree
+    assert "h_0" in state.params and "layers" not in state.params
+    assert "retrying with the unrolled layer layout" in capsys.readouterr().err
+
+
+def test_scan_fallback_refuses_cross_layout_resume(tmp_path, monkeypatch):
+    """With scan-layout checkpoints on disk, the unrolled fallback would
+    resume a stacked 'layers' tree into a per-block model — refuse loudly."""
+    import sys
+    from pathlib import Path
+
+    import pytest
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+    import train_gpt2
+
+    rng = np.random.Generator(np.random.PCG64(5))
+    binf = tmp_path / "corpus.bin"
+    rng.integers(0, 256, 40_000).astype(np.uint16).tofile(binf)
+    common = [
+        "--tokens", str(binf), "--vocab_size", "256", "--seq_len", "64",
+        "--batch_size", "1", "--hidden_dim", "32", "--depth", "2",
+        "--num_heads", "2", "--epochs", "1", "--no_profiler",
+        "--scan_layers", "--log_dir", str(tmp_path),
+        "--checkpoint_dir", str(tmp_path / "ckpt"), "--JobID", "ScanCkpt",
+    ]
+    train_gpt2.main(common)  # writes a scan-layout checkpoint
+    monkeypatch.setenv("TPUDIST_TEST_FAIL_SCAN_COMPILE", "1")
+    with pytest.raises(RuntimeError, match="unstack_layers"):
+        train_gpt2.main(common)

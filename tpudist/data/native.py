@@ -111,6 +111,13 @@ class NativeBatcher:
         if src.dtype != np.uint8:
             raise TypeError(f"expected uint8 source, got {src.dtype}")
         _require_contiguous(src)
+        if src.ndim < 2:
+            # a 1-D source would make channels == len(src) and the kernel
+            # would read scale/shift far out of bounds
+            raise ValueError(
+                "per-channel gather needs src.ndim >= 2 ([N, ..., C]); got "
+                f"shape {src.shape}"
+            )
         channels = src.shape[-1]
         scale = np.ascontiguousarray(scale, np.float32)
         shift = np.ascontiguousarray(shift, np.float32)

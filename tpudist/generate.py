@@ -71,6 +71,16 @@ def generate(
         model, params, cache, prompt, jax.random.key(seed),
         max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k,
     )
+    if not out.is_fully_addressable:
+        # multi-process with sharded/global params: the jit output may span
+        # hosts, and np.asarray on a non-addressable array raises; every
+        # process runs the same decode on the same prompt, so allgathering
+        # the token ids (tiny) yields the identical [B, T] everywhere
+        from jax.experimental import multihost_utils
+
+        # tiled=True is required for global non-addressable inputs and
+        # returns the global [B, T] (no leading process dim)
+        return np.asarray(multihost_utils.process_allgather(out, tiled=True))
     return np.asarray(out)
 
 

@@ -578,6 +578,10 @@ def evaluate_lm(
 
     Scores EVERY window: a ragged final batch is padded to the mesh's
     replica count and masked out of both numerator and denominator.
+    Multi-process accounting follows the global mask (see
+    :func:`evaluate`), so per-process loaders may be identical full copies
+    or disjoint shards — both score correctly, as long as every process
+    yields the same number of batches (collectives run in lockstep).
     ``chunk`` scans the LM head over sequence chunks
     (:func:`tpudist.models.lm_utils.chunked_ce_sum`) so the [B,S,V] fp32
     logits never materialize — pass it whenever training needed
@@ -599,10 +603,11 @@ def evaluate_lm(
                 {"params": params}, tokens, train=False, return_hidden=True
             )
             b, s = tokens.shape
-            return chunked_ce_sum(
+            ce_sum = chunked_ce_sum(
                 lm_head_weight(params), hidden[:, :-1], tokens[:, 1:],
                 mask[:, None] * jnp.ones((b, s - 1)), chunk,
             )
+            return ce_sum, jnp.sum(mask)
     else:
 
         @jax.jit
@@ -612,17 +617,22 @@ def evaluate_lm(
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tokens[:, 1:]
             )
-            return jnp.sum(jnp.where(mask[:, None], ce, 0.0))
+            return jnp.sum(jnp.where(mask[:, None], ce, 0.0)), jnp.sum(mask)
 
     total, positions = 0.0, 0
-    for batch, mask, n in _padded_batches(loader, mesh, input_key):
+    for batch, mask, _ in _padded_batches(loader, mesh, input_key):
         s = batch[input_key].shape[1]
-        total += float(batch_ce(state.params, batch, mask))
-        # multi-process: every process contributes its batch copy as a shard
-        # (same accounting as evaluate())
-        positions += n * (s - 1) * jax.process_count()
+        # windows counted from the global mask, in-graph — same
+        # replicated-or-sharded-safe accounting as evaluate()
+        ce_sum, windows = batch_ce(state.params, batch, mask)
+        total += float(ce_sum)
+        positions += int(windows) * (s - 1)
     loss = total / max(positions, 1)
-    return {"loss": loss, "perplexity": math.exp(min(loss, 30.0))}
+    # no silent clamp: a diverged model reports its true (astronomical)
+    # perplexity, or inf past float range — never a cap masquerading as a
+    # measurement
+    ppl = math.exp(loss) if loss < 700.0 else float("inf")
+    return {"loss": loss, "perplexity": ppl}
 
 
 def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
@@ -633,6 +643,14 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
     Scores EVERY sample: a final batch that doesn't divide the mesh's
     replica count is padded (repeating the last row) and the padding is
     masked out of the correct-count, so no val tail is silently dropped.
+
+    Multi-process: both the hit-count and the denominator are sums over the
+    global mask inside the compiled program, so each process's loader may
+    be an identical full copy of the val set (the reference's convention,
+    /root/reference/main.py:56-63) or its own disjoint shard (e.g. via
+    ``DistributedSampler``) — both produce the correct global accuracy.
+    The one requirement is lockstep: every process must yield the same
+    number of batches, which both conventions satisfy.
     """
     mesh = mesh or mesh_lib.create_mesh()
 
@@ -641,13 +659,17 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
         variables = {"params": params, "batch_stats": batch_stats}
         logits = model.apply(variables, batch[input_key], train=False)
         hit = jnp.argmax(logits, axis=-1) == batch[label_key]
-        return jnp.sum(jnp.where(mask, hit, False))
+        # the denominator comes from the SAME global mask as the numerator,
+        # in-graph: correct whether each process feeds an identical full val
+        # loader (the reference's convention — every row counted
+        # process_count times, in both sums) or its own disjoint shard. A
+        # host-side `n × process_count` denominator would silently mis-scale
+        # the sharded case.
+        return jnp.sum(jnp.where(mask, hit, False)), jnp.sum(mask)
 
     cnt, total = 0, 0
-    for batch, mask, n in _padded_batches(loader, mesh, label_key):
-        cnt += int(count_correct(state.params, state.batch_stats, batch, mask))
-        # multi-process: every process contributes its batch copy as a shard,
-        # so the summed hit-count is over process_count × n rows — the
-        # denominator must match or accuracy inflates by process_count
-        total += n * jax.process_count()
+    for batch, mask, _ in _padded_batches(loader, mesh, label_key):
+        c, t = count_correct(state.params, state.batch_stats, batch, mask)
+        cnt += int(c)
+        total += int(t)
     return cnt / max(total, 1)
