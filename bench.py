@@ -271,6 +271,63 @@ def bench_gpt2() -> None:
     )
 
 
+def bench_gpt2_long_context() -> None:
+    """Long-context leg: GPT-2 124M at seq 4096, Pallas flash attention vs
+    the XLA einsum oracle on the identical step. ``vs_baseline`` here is the
+    flash/XLA speedup — long context is where the S² score matrix thrashes
+    HBM and the framework's own kernel is the baseline-beater
+    (docs/PERF.md §4)."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    seq_len, micro = 4096, 4
+    tokens_per_step = micro * n_chips * seq_len
+    rng = np.random.Generator(np.random.PCG64(0))
+    host = rng.integers(0, 50257, (micro * n_chips, seq_len)).astype(np.int32)
+
+    def rate(attn_impl, n_steps=12):
+        model = GPT2(
+            dtype=jnp.bfloat16, max_seq_len=seq_len, attn_impl=attn_impl
+        )
+        tx = optax.adam(1e-3)
+        state = create_train_state(
+            model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens",
+            forward_loss=chunked_lm_forward(model, chunk=256),
+        )
+        for _ in range(3):
+            state, metrics = step(state, {"tokens": host})
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, {"tokens": host})
+        float(metrics["loss"])
+        return tokens_per_step * n_steps / (time.perf_counter() - t0)
+
+    xla = rate("xla")
+    flash = rate("flash")
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_124m_s4096_flash_tokens_per_sec_per_chip",
+                "value": round(flash / n_chips, 2),
+                "unit": "tokens/sec/chip (bf16, seq 4096, flash attention, "
+                "chunked CE); vs_baseline = speedup over the identical "
+                "XLA-attention step "
+                f"({round(xla / n_chips, 1)} tok/s/chip)",
+                "vs_baseline": round(flash / xla, 4),
+            }
+        ),
+        flush=True,
+    )
+
+
 def _run_with_retry(fn) -> None:
     """The remote-compile tunnel occasionally 500s transiently; one retry
     keeps a flake from recording a failed benchmark for the whole round.
@@ -298,6 +355,7 @@ def _run_with_retry(fn) -> None:
 def main() -> None:
     _run_with_retry(bench_resnet)
     _run_with_retry(bench_gpt2)
+    _run_with_retry(bench_gpt2_long_context)
 
 
 if __name__ == "__main__":
