@@ -179,12 +179,22 @@ def bench_resnet() -> None:
     # resolution)
     state, dt = _drive(step_e2e, state, stream, warmup, timed)
     stream.close()
+    # record the attach link's H2D rate alongside the number: on a
+    # remote-attach chip this leg is link-bound (docs/PERF.md §3), and the
+    # probe lets each round's artifact show what the link sustained
+    probe = rng.integers(0, 256, (32 * 1024 * 1024,), dtype=np.uint8)
+    t0 = time.perf_counter()
+    # sync by value fetch, not block_until_ready (which the tunnel has been
+    # observed to release early — same rule as the step timers above)
+    int(np.asarray(jax.device_put(probe)[-1]))
+    h2d_mbps = probe.nbytes / 1e6 / (time.perf_counter() - t0)
     _emit(
         "resnet50_e2e_images_per_sec_per_chip",
         batch * timed / dt / n_chips,
         "images/sec/chip e2e: sampler+C++ gather+uint8 H2D+device "
-        "normalize+step (bf16, batch 256/chip, 224x224); link-bound on a "
-        "remote-attach chip — docs/PERF.md quantifies",
+        "normalize+step (bf16, batch 256/chip, 224x224); link-bound when "
+        f"H2D is slow — this run's H2D probe: {h2d_mbps:.0f} MB/s "
+        "(needs 385 MB/s to hide staging; docs/PERF.md quantifies)",
         TARGET_IMG_PER_SEC_PER_CHIP,
     )
 

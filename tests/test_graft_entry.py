@@ -49,6 +49,7 @@ def test_dryrun_multichip_provisions_own_mesh():
         "TP: ok",
         "LLAMA(tp): ok",
         "LLAMA(scan+remat,tp): ok",
+        "BERT(mlm,tp): ok",
         "PP: ok",
         "SP(ring): ok",
         "SP(ulysses): ok",

@@ -1,6 +1,7 @@
 """Model zoo: the reference's model (ResNet-50, /root/reference/main.py:40)
 plus the BASELINE.json ladder (ResNet-18, ViT-B/16, GPT-2 124M), depth
-variants (ResNet-34/101/152), and the Llama decoder family (RoPE/GQA/SwiGLU)."""
+variants (ResNet-34/101/152), the Llama decoder family (RoPE/GQA/SwiGLU),
+and the BERT encoder family (bidirectional + masked-LM objective)."""
 
 from tpudist.models.resnet import (
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
@@ -10,9 +11,11 @@ from tpudist.models.gpt2 import GPT2, gpt2_124m, gpt2_medium, gpt2_large
 from tpudist.models.llama import (
     Llama, llama_125m, llama2_7b, llama3_8b, mixtral_8x7b,
 )
+from tpudist.models.bert import Bert, bert_base, bert_large
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "ViT", "vit_b16", "GPT2", "gpt2_124m", "gpt2_medium", "gpt2_large",
     "Llama", "llama_125m", "llama2_7b", "llama3_8b", "mixtral_8x7b",
+    "Bert", "bert_base", "bert_large",
 ]

@@ -1,0 +1,276 @@
+"""BERT-style bidirectional encoder with a masked-LM objective.
+
+No reference counterpart (the reference is a single ResNet DDP script,
+SURVEY.md §2.12); built as a capability extension: the encoder complement
+of the GPT-2/Llama decoder families, sharing the framework's contracts —
+the same Megatron TP metadata scheme over the ``tensor`` axis
+(``tpudist.parallel.tp``), the same attention ops (``tpudist.ops``), the
+``return_hidden`` hook, and the ``forward_loss`` train-step interface
+(:func:`mlm_forward` plugs into ``make_train_step`` exactly like
+``chunked_lm_forward``).
+
+Architecture follows BERT-base conventions: learned token+position (+
+segment) embeddings with post-embedding LayerNorm, post-LN transformer
+blocks with bidirectional attention and GELU MLPs, and a weight-tied MLM
+head behind BERT's dense+LN "transform".
+
+The MLM corruption runs host-side as a loader ``transform``
+(:func:`mlm_transform`) with the standard 80/10/10 recipe — integer ops on
+the host keep the device step static-shaped, and the transform slots into
+the existing DataLoader/TokenWindowLoader pipeline like any augmentation.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tpudist.mesh import TENSOR_AXIS
+from tpudist.ops.attention import multi_head_attention
+from tpudist.parallel.tp import partitioned as _partitioned
+
+
+class EncoderBlock(nn.Module):
+    """Post-LN bidirectional transformer block (BERT convention: the
+    residual sum is normalized, rather than the branch input)."""
+
+    num_heads: int
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, s, d = x.shape
+        h = self.num_heads
+        drop = lambda y: (
+            nn.Dropout(self.dropout, deterministic=not train)(y)
+            if self.dropout else y
+        )
+        dense_init = nn.initializers.lecun_normal()
+        # column-parallel qkv / row-parallel out — same TP scheme as the
+        # decoder Block (tpudist/models/gpt2.py), no causal mask
+        qkv = nn.DenseGeneral(
+            (3, h, d // h), dtype=self.dtype, name="qkv",
+            kernel_init=_partitioned(dense_init, None, None, TENSOR_AXIS, None),
+            bias_init=_partitioned(
+                nn.initializers.zeros_init(), None, TENSOR_AXIS, None
+            ),
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = multi_head_attention(q, k, v, causal=False, impl=self.attn_impl)
+        y = nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, name="out",
+            kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
+        )(attn)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln_attn")(
+            x + drop(y)
+        )
+        y = nn.Dense(
+            4 * d, dtype=self.dtype, name="mlp_fc",
+            kernel_init=_partitioned(dense_init, None, TENSOR_AXIS),
+            bias_init=_partitioned(nn.initializers.zeros_init(), TENSOR_AXIS),
+        )(x)
+        y = nn.gelu(y)
+        y = nn.Dense(
+            d, dtype=self.dtype, name="mlp_proj",
+            kernel_init=_partitioned(dense_init, TENSOR_AXIS, None),
+        )(y)
+        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln_mlp")(
+            x + drop(y)
+        )
+
+
+class MlmHead(nn.Module):
+    """BERT's MLM head: transform (dense + gelu + LN) then the weight-tied
+    decode against the embedding table with a free output bias. A submodule
+    (its own param scope) so :func:`mlm_forward`'s chunked path can apply it
+    per sequence chunk without duplicating the math."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, wte):
+        d = wte.shape[1]
+        y = nn.Dense(d, dtype=self.dtype, name="transform")(x)
+        y = nn.gelu(y)
+        y = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln")(y)
+        logits = jnp.einsum(
+            "...d,vd->...v", y, wte.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (wte.shape[0],), jnp.float32
+        )
+        return logits + bias
+
+
+class Bert(nn.Module):
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    type_vocab: int = 2
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
+                 token_types=None):
+        b, s = tokens.shape
+        if s > self.max_seq_len:
+            raise ValueError(
+                f"sequence {s} exceeds max_seq_len {self.max_seq_len}"
+            )
+        wte = self.param(
+            "wte",
+            _partitioned(nn.initializers.normal(0.02), TENSOR_AXIS, None),
+            (self.vocab_size, self.hidden_dim), jnp.float32,
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.02),
+            (self.max_seq_len, self.hidden_dim), jnp.float32,
+        )
+        x = wte[tokens] + wpe[:s]
+        if self.type_vocab:
+            wty = self.param(
+                "wty", nn.initializers.normal(0.02),
+                (self.type_vocab, self.hidden_dim), jnp.float32,
+            )
+            types = (
+                jnp.zeros_like(tokens) if token_types is None else token_types
+            )
+            x = x + wty[types]
+        x = nn.LayerNorm(
+            epsilon=1e-12, dtype=self.dtype, name="ln_embed"
+        )(x.astype(self.dtype))
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
+                dropout=self.dropout, name=f"h_{i}",
+            )(x, train=train)
+        if return_hidden:
+            return x
+        return MlmHead(dtype=self.dtype, name="mlm_head")(x, wte)
+
+
+def bert_base(**kw) -> Bert:
+    return Bert(**kw)
+
+
+def bert_large(**kw) -> Bert:
+    kw.setdefault("hidden_dim", 1024)
+    kw.setdefault("depth", 24)
+    kw.setdefault("num_heads", 16)
+    return Bert(**kw)
+
+
+def mlm_transform(
+    vocab_size: int, mask_id: int, *, mask_rate: float = 0.15,
+    random_rate: float = 0.1, keep_rate: float = 0.1, seed: int = 0,
+    key: str = "tokens",
+):
+    """Loader transform applying BERT's MLM corruption on the host.
+
+    Each position is selected with probability ``mask_rate``; of the
+    selected, 80% become ``mask_id``, 10% a uniformly random id, 10% stay
+    unchanged (the 80/10/10 recipe — ``random_rate``/``keep_rate`` are
+    fractions OF the selected positions). Produces
+    ``{"tokens": corrupted, "targets": originals, "mlm_mask": bool}``.
+    Randomness is a seeded per-loader stream, like the augmentation
+    transforms (tpudist/data/transforms.py) — deterministic order, not
+    replayed across a mid-epoch resume.
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+
+    def run(batch):
+        tokens = np.asarray(batch[key])
+        u = rng.random(tokens.shape)
+        selected = u < mask_rate
+        # carve the selected mass into mask/random/keep sub-ranges of u
+        to_random = selected & (u < mask_rate * random_rate)
+        to_keep = selected & (u >= mask_rate * (1.0 - keep_rate))
+        to_mask = selected & ~to_random & ~to_keep
+        corrupted = tokens.copy()
+        corrupted[to_mask] = mask_id
+        corrupted[to_random] = rng.integers(
+            0, vocab_size, int(to_random.sum())
+        )
+        out = dict(batch)
+        out[key] = corrupted
+        out["targets"] = tokens
+        out["mlm_mask"] = selected
+        return out
+
+    return run
+
+
+def mlm_forward(model: Bert, chunk: int | None = None):
+    """``forward_loss`` for :func:`tpudist.train.make_train_step`: mean CE
+    over the corrupted positions only — the MLM objective. Expects batches
+    from :func:`mlm_transform` (``tokens``/``targets``/``mlm_mask``).
+
+    ``chunk`` scans the MLM head over sequence chunks with a checkpointed
+    body, bounding live logits to [B, chunk, V] in forward AND backward —
+    the same HBM discipline as ``chunked_lm_forward`` (at bert-base shapes,
+    batch 32 × seq 512 × V=30522 fp32 logits are ~2 GB otherwise).
+    """
+    import jax
+    import optax
+
+    if getattr(model, "dropout", 0.0):
+        raise ValueError(
+            "mlm_forward has no rng stream; use dropout=0 (match "
+            "chunked_lm_forward's contract) or extend the default forward"
+        )
+
+    head = MlmHead(dtype=model.dtype)
+
+    def masked_ce_sum(logits, targets, mask):
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return jnp.sum(ce * mask)
+
+    def forward_loss(params, batch_stats, batch):
+        mask = batch["mlm_mask"].astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        if chunk is None:
+            logits = model.apply(
+                {"params": params}, batch["tokens"], train=True
+            )
+            loss = masked_ce_sum(logits, batch["targets"], mask) / denom
+            return loss, batch_stats
+
+        hidden = model.apply(
+            {"params": params}, batch["tokens"], train=True,
+            return_hidden=True,
+        )
+        wte = nn.meta.unbox(params["wte"])
+        head_params = {"params": nn.meta.unbox(params["mlm_head"])}
+        b, s, d = hidden.shape
+        pad = -s % chunk
+        if pad:
+            hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(batch["targets"], ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        nc = (s + pad) // chunk
+        hs = hidden.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)
+        ts = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
+        ms = mask.reshape(b, nc, chunk).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def body(carry, xs):
+            hc, tc, mc = xs
+            logits = head.apply(head_params, hc, wte)
+            return carry + masked_ce_sum(logits, tc, mc), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
+        return total / denom, batch_stats
+
+    return forward_loss
