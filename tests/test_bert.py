@@ -156,3 +156,28 @@ def test_chunked_mlm_forward_matches_full():
     np.testing.assert_allclose(
         np.asarray(chunked), np.asarray(full), rtol=1e-5, atol=1e-6
     )
+
+
+def test_train_bert_example_e2e(tmp_path):
+    """examples/train_bert.py end-to-end: memmap corpus -> MLM corruption ->
+    fit -> masked eval, with the reserved [MASK] id above the corpus vocab."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+    import train_bert
+
+    binf = tmp_path / "corpus.bin"
+    np.frombuffer(b"the quick brown fox jumps over the lazy dog. " * 400,
+                  np.uint8).astype(np.uint16).tofile(binf)
+    state, losses = train_bert.main([
+        "--tokens", str(binf), "--vocab_size", "256", "--seq_len", "32",
+        "--batch_size", "2", "--hidden_dim", "32", "--depth", "1",
+        "--num_heads", "2", "--epochs", "2", "--lr", "3e-3",
+        "--no_profiler", "--log_dir", str(tmp_path), "--JobID", "BertE2E",
+        "--eval", "--chunked_ce", "16",
+    ])
+    assert len(losses) > 0 and np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # the reserved mask id extends the vocab by one
+    assert state.params["wte"].shape[0] == 257

@@ -87,9 +87,15 @@ class TokenWindowLoader(SampledLoader):
         shuffle: bool = True,
         targets_in_window: bool = False,
         drop_remainder: bool = True,
+        transform=None,
     ):
         if isinstance(source, (str, os.PathLike)):
             source = load_token_stream(source, dtype=dtype)
+        # dict -> dict over the gathered batch, applied after the vocab
+        # check — e.g. the BERT MLM corruption
+        # (tpudist.models.bert.mlm_transform), same contract as the
+        # DataLoader's transform
+        self.transform = transform
         self.flat = source
         self.batch_size = batch_size
         self.seq_len = seq_len
@@ -126,4 +132,5 @@ class TokenWindowLoader(SampledLoader):
         return {"tokens": tokens}
 
     def _gather_batch(self, idx: np.ndarray, start: int) -> dict:
-        return self.gather(idx)
+        batch = self.gather(idx)
+        return self.transform(batch) if self.transform is not None else batch

@@ -220,10 +220,13 @@ def mlm_forward(model: Bert, chunk: int | None = None):
     ``chunk`` scans the MLM head over sequence chunks with a checkpointed
     body, bounding live logits to [B, chunk, V] in forward AND backward —
     the same HBM discipline as ``chunked_lm_forward`` (at bert-base shapes,
-    batch 32 × seq 512 × V=30522 fp32 logits are ~2 GB otherwise).
+    batch 32 × seq 512 × V=30522 fp32 logits are ~2 GB otherwise). The
+    chunk path rides the shared :func:`~tpudist.models.lm_utils.
+    chunked_head_reduce` skeleton with :func:`mlm_head_logits_fn`.
     """
-    import jax
     import optax
+
+    from tpudist.models.lm_utils import chunked_head_reduce
 
     if getattr(model, "dropout", 0.0):
         raise ValueError(
@@ -251,26 +254,19 @@ def mlm_forward(model: Bert, chunk: int | None = None):
             {"params": params}, batch["tokens"], train=True,
             return_hidden=True,
         )
-        wte = nn.meta.unbox(params["wte"])
-        head_params = {"params": nn.meta.unbox(params["mlm_head"])}
-        b, s, d = hidden.shape
-        pad = -s % chunk
-        if pad:
-            hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
-        targets = jnp.pad(batch["targets"], ((0, 0), (0, pad)))
-        mask = jnp.pad(mask, ((0, 0), (0, pad)))
-        nc = (s + pad) // chunk
-        hs = hidden.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)
-        ts = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
-        ms = mask.reshape(b, nc, chunk).transpose(1, 0, 2)
-
-        @jax.checkpoint
-        def body(carry, xs):
-            hc, tc, mc = xs
-            logits = head.apply(head_params, hc, wte)
-            return carry + masked_ce_sum(logits, tc, mc), None
-
-        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
+        total = chunked_head_reduce(
+            mlm_head_logits_fn(head, params), hidden, batch["targets"],
+            mask, chunk,
+        )
         return total / denom, batch_stats
 
     return forward_loss
+
+
+def mlm_head_logits_fn(head: MlmHead, params):
+    """``logits_fn`` for ``chunked_head_reduce``: BERT's transform + tied
+    decode, applied per hidden chunk through the :class:`MlmHead` module
+    (no duplicated head math)."""
+    wte = nn.meta.unbox(params["wte"])
+    head_params = {"params": nn.meta.unbox(params["mlm_head"])}
+    return lambda hc: head.apply(head_params, hc, wte)

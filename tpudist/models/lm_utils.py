@@ -57,15 +57,20 @@ def lm_head_weight(params):
     raise ValueError(f"no LM head weight among params: {list(params)}")
 
 
-def chunked_ce_sum(head_w, h, targets, pos_mask, chunk: int):
-    """Sum of softmax-CE over masked positions, scanning the LM head over
-    sequence chunks so live logits are bounded by [B, chunk, V] in forward
-    AND backward (``jax.checkpoint`` recomputes each chunk's logits).
+def chunked_head_reduce(
+    logits_fn, h, targets, pos_mask, chunk: int, *, hits: bool = False
+):
+    """Scan an arbitrary position-wise head over sequence chunks with a
+    checkpointed body, so live logits are bounded by [B, chunk, V] in
+    forward AND backward.
 
-    ``h``: [B, S, D] hidden states; ``targets``/``pos_mask``: [B, S].
-    The one home for the chunked-head math — both the training loss
-    (:func:`chunked_lm_forward`) and eval (:func:`tpudist.train.evaluate_lm`)
-    ride it, so HBM behavior can't diverge between the two.
+    ``logits_fn``: [B, chunk, D] hidden chunk → [B, chunk, V] logits (any
+    head: a tied-matmul, BERT's transform+decode, ...). ``h``: [B, S, D];
+    ``targets``/``pos_mask``: [B, S]. Returns the masked softmax-CE sum,
+    plus the masked argmax-hit count when ``hits`` (for accuracy-style
+    eval). The one home for the chunked-head skeleton — every chunked
+    train loss and eval path rides it, so HBM behavior can't diverge
+    between them.
     """
     import optax
 
@@ -86,15 +91,44 @@ def chunked_ce_sum(head_w, h, targets, pos_mask, chunk: int):
     @jax.checkpoint
     def body(carry, xs):
         hc, tc, mc = xs
-        logits = jnp.einsum(
+        logits = logits_fn(hc)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        ce_sum = carry[0] + jnp.sum(ce * mc)
+        hit_sum = carry[1]
+        if hits:
+            hit = jnp.argmax(logits, axis=-1) == tc
+            hit_sum = hit_sum + jnp.sum(jnp.where(mc > 0, hit, False))
+        return (ce_sum, hit_sum), None
+
+    (total, hit_total), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hs, ts, ms),
+    )
+    return (total, hit_total) if hits else total
+
+
+def tied_head_logits_fn(head_w):
+    """``logits_fn`` for :func:`chunked_head_reduce`: the weight-tied decode
+    against a [V, D] table (GPT-2's ``wte``, Llama's head)."""
+
+    def logits_fn(hc):
+        return jnp.einsum(
             "bcd,vd->bcv", hc, head_w.astype(hc.dtype),
             preferred_element_type=jnp.float32,
         )
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
-        return carry + jnp.sum(ce * mc), None
 
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
-    return total
+    return logits_fn
+
+
+def chunked_ce_sum(head_w, h, targets, pos_mask, chunk: int):
+    """Masked softmax-CE sum under the weight-tied head — the decoder
+    families' instantiation of :func:`chunked_head_reduce` (training via
+    :func:`chunked_lm_forward`, eval via :func:`tpudist.train.evaluate_lm`).
+    """
+    return chunked_head_reduce(
+        tied_head_logits_fn(head_w), h, targets, pos_mask, chunk
+    )
 
 
 def chunked_lm_forward(model, chunk: int = 256):
