@@ -94,3 +94,37 @@ def test_emulate_devices_env(tmp_path):
         tmp_path, ["--nproc_per_node=2", "--emulate-devices=4"], body
     )
     assert r.returncode == 0, r.stderr
+
+
+def test_max_restarts_recovers_transient_failure(tmp_path):
+    """--max_restarts relaunches the node's world after a non-zero exit —
+    the elastic-recovery extension over the reference's fail-fast; with the
+    trainer's checkpoint resume this is the crash-recovery story."""
+    body = textwrap.dedent("""
+        import os, sys
+        marker = os.path.join(os.environ["OUT_DIR"], "crashed_once")
+        if not os.path.exists(marker):
+            if os.environ["RANK"] == "1":
+                open(marker, "w").close()
+                sys.exit(7)   # transient: first generation loses rank 1
+            import time; time.sleep(20)  # rank 0 waits to be terminated
+        # second generation: everyone succeeds
+    """)
+    os.environ["OUT_DIR"] = str(tmp_path)
+    try:
+        r = _run_launcher(tmp_path, ["--nproc_per_node=2", "--max_restarts=2"], body)
+    finally:
+        del os.environ["OUT_DIR"]
+    assert r.returncode == 0, r.stderr
+    assert "restarting (1/2)" in r.stderr
+    assert (tmp_path / "crashed_once").exists()
+
+
+def test_max_restarts_exhausted_reports_failure(tmp_path):
+    body = textwrap.dedent("""
+        import sys
+        sys.exit(9)  # deterministic failure: every generation dies
+    """)
+    r = _run_launcher(tmp_path, ["--nproc_per_node=2", "--max_restarts=1"], body)
+    assert r.returncode == 9
+    assert r.stderr.count("restarting") == 1

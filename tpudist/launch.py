@@ -51,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--no_python", action="store_true",
                    help="run the script as an executable instead of `python script`")
+    p.add_argument(
+        "--max_restarts", type=int, default=0,
+        help="relaunch this node's processes up to N times after a non-zero "
+        "exit — elastic-style recovery beyond the reference's fail-fast "
+        "(SURVEY.md §5); pair with the trainer's --checkpoint_dir so the "
+        "relaunched run resumes from the last checkpoint. 0 = fail fast.",
+    )
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
@@ -58,6 +65,22 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    attempt = 0
+    while True:
+        rc = _run_world(args)
+        # 130 = operator interrupt — never auto-restart over a Ctrl-C
+        if rc == 0 or rc == 130 or attempt >= args.max_restarts:
+            return rc
+        attempt += 1
+        print(
+            f"tpudist.launch: world exited rc={rc}; restarting "
+            f"({attempt}/{args.max_restarts})",
+            file=sys.stderr,
+        )
+
+
+def _run_world(args) -> int:
+    """Spawn and supervise one generation of this node's processes."""
     world_size = args.nnode * args.nproc_per_node
     procs: list[subprocess.Popen] = []
     for local_rank in range(args.nproc_per_node):
