@@ -70,6 +70,11 @@ def parse_args(argv=None):
     p.add_argument("--eval", action="store_true",
                    help="masked-prediction loss + accuracy on the held-out "
                    "stream (or the train stream in order)")
+    p.add_argument("--init_hf", default=None, type=str,
+                   help="warm-start from a LOCAL HF BertForMaskedLM "
+                   "checkpoint dir (tpudist.interop); sizes must match the "
+                   "model flags, and --mask_id should name the tokenizer's "
+                   "[MASK] id (BERT-base: 103)")
     return p.parse_args(argv)
 
 
@@ -137,6 +142,21 @@ def main(argv=None):
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
     )
 
+    init_params = None
+    if args.init_hf:
+        from tpudist.interop import load_hf_params
+
+        if args.mask_id is None:
+            raise SystemExit(
+                "--init_hf needs --mask_id (the pretrained tokenizer's "
+                "[MASK] id; the +1 reserved-id vocab wouldn't match the "
+                "checkpoint)"
+            )
+        init_params = load_hf_params(
+            args.init_hf, arch="bert", depth=args.depth,
+            num_heads=args.num_heads,
+        )
+
     dp_size = mesh_lib.data_parallel_size(mesh)
     t0 = time.time()
     state, losses = fit(
@@ -151,6 +171,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
+        init_params=init_params,
     )
     wall = time.time() - t0
     if losses and ctx.process_index == 0:

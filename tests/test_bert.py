@@ -181,3 +181,52 @@ def test_train_bert_example_e2e(tmp_path):
     assert losses[-1] < losses[0]
     # the reserved mask id extends the vocab by one
     assert state.params["wte"].shape[0] == 257
+
+
+def test_train_bert_init_hf_warm_start(tmp_path):
+    """--init_hf warm-starts from a local HF BertForMaskedLM checkpoint
+    through tpudist.interop (sizes from flags, tokenizer's own [MASK] id)."""
+    import sys
+    from pathlib import Path
+
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from safetensors.torch import save_file
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+    import train_bert
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2,
+    )
+    torch.manual_seed(11)
+    hf = transformers.BertForMaskedLM(cfg)
+    ckpt = tmp_path / "hf"
+    ckpt.mkdir()
+    # clone() breaks the tied-tensor aliases safetensors refuses to save
+    save_file(
+        {k: v.clone().contiguous() for k, v in hf.state_dict().items()},
+        str(ckpt / "model.safetensors"),
+    )
+
+    binf = tmp_path / "corpus.bin"
+    rng = np.random.Generator(np.random.PCG64(12))
+    # short corpus → ~30 steps at lr 1e-4: weights stay near the warm start
+    rng.integers(0, 64, 2_000).astype(np.uint16).tofile(binf)
+    state, losses = train_bert.main([
+        "--tokens", str(binf), "--vocab_size", "64", "--mask_id", "3",
+        "--init_hf", str(ckpt),
+        "--seq_len", "32", "--batch_size", "2", "--hidden_dim", "32",
+        "--depth", "1", "--num_heads", "2", "--epochs", "1",
+        "--no_profiler", "--log_dir", str(tmp_path), "--JobID", "BertHF",
+    ])
+    assert len(losses) > 0 and np.isfinite(losses).all()
+    # warm start actually took: wte equals the HF table, not a fresh init
+    want = hf.state_dict()["bert.embeddings.word_embeddings.weight"].numpy()
+    np.testing.assert_allclose(
+        np.asarray(state.params["wte"])[: want.shape[0]], want, atol=2e-2
+    )

@@ -242,3 +242,101 @@ def test_llama_logits_match_transformers(kv_heads, tied):
     )
     got = model.apply({"params": params}, jnp.asarray(tokens), train=False)
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_logits_match_transformers():
+    from tpudist.interop import bert_params_from_hf
+    from tpudist.models.bert import Bert
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu", attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    hf = transformers.BertForMaskedLM(cfg).eval()
+    tokens = _tokens()
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+
+    params = bert_params_from_hf(hf.state_dict(), depth=2, num_heads=4)
+    model = Bert(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=2,
+                 num_heads=4)
+    got = model.apply({"params": params}, jnp.asarray(tokens), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_param_tree_matches_model_init():
+    import jax
+    from flax import linen as nn
+
+    from tpudist.interop import bert_params_from_hf
+    from tpudist.models.bert import Bert
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2,
+    )
+    torch.manual_seed(3)
+    hf = transformers.BertForMaskedLM(cfg)
+    params = bert_params_from_hf(hf.state_dict(), depth=2, num_heads=4)
+    model = Bert(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=2,
+                 num_heads=4)
+    want = nn.meta.unbox(
+        model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                   train=False)["params"]
+    )
+    got_paths = {jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_flatten_with_path(params)[0]}
+    want_paths = {jax.tree_util.keystr(p) for p, _ in
+                  jax.tree_util.tree_flatten_with_path(want)[0]}
+    assert got_paths == want_paths
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(want)[0],
+    ):
+        assert np.shape(a) == np.shape(b), (pa, np.shape(a), np.shape(b))
+
+
+def test_bert_export_roundtrips_into_transformers():
+    """tpudist-trained BERT weights → save_hf_checkpoint → HF
+    BertForMaskedLM reproduces our logits (the hand-off direction)."""
+    import jax
+
+    from tpudist.interop import bert_params_to_hf
+    from tpudist.models.bert import Bert
+
+    model = Bert(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=2,
+                 num_heads=4)
+    tokens = _tokens(seed=5)
+    from flax import linen as nn
+
+    params = nn.meta.unbox(
+        model.init(jax.random.key(7), jnp.asarray(tokens), train=False)[
+            "params"
+        ]
+    )
+    ours = np.asarray(
+        model.apply({"params": params}, jnp.asarray(tokens), train=False)
+    )
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu", attn_implementation="eager",
+    )
+    hf = transformers.BertForMaskedLM(cfg).eval()
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in bert_params_to_hf(params, depth=2).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # only non-weight buffers / the untrained pooler may be missing
+    assert all("pooler" in k or "position_ids" in k for k in missing), missing
+    assert not unexpected, unexpected
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)

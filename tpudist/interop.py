@@ -164,6 +164,139 @@ def llama_params_from_hf(
     return params
 
 
+def bert_params_from_hf(state_dict, *, depth: int, num_heads: int) -> dict:
+    """HF ``BertForMaskedLM``/``BertModel`` state dict →
+    :class:`tpudist.models.bert.Bert` params.
+
+    Linears are ``nn.Linear`` ([out, in] — transpose); q/k/v are separate
+    and stack into our packed ``qkv`` kernel; the MLM head maps
+    ``cls.predictions.transform``/``.bias`` onto ``mlm_head`` (the decoder
+    matrix is tied to ``wte`` in both). The pooler (absent from the MLM
+    loss) is ignored.
+    """
+    sd = {k.removeprefix("bert."): v for k, v in state_dict.items()}
+    wte = _np(sd["embeddings.word_embeddings.weight"])
+    d = wte.shape[1]
+    h = num_heads
+    dh = d // h
+
+    def ln(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    params = {
+        "wte": wte,
+        "wpe": _np(sd["embeddings.position_embeddings.weight"]),
+        "wty": _np(sd["embeddings.token_type_embeddings.weight"]),
+        "ln_embed": ln("embeddings.LayerNorm"),
+    }
+    for i in range(depth):
+        p = f"encoder.layer.{i}"
+        qkv_k = np.stack(
+            [
+                _np(sd[f"{p}.attention.self.{n}.weight"]).T.reshape(d, h, dh)
+                for n in ("query", "key", "value")
+            ],
+            axis=1,
+        )  # [D, 3, H, dh]
+        qkv_b = np.stack(
+            [
+                _np(sd[f"{p}.attention.self.{n}.bias"]).reshape(h, dh)
+                for n in ("query", "key", "value")
+            ],
+            axis=0,
+        )  # [3, H, dh]
+        params[f"h_{i}"] = {
+            "qkv": {"kernel": qkv_k, "bias": qkv_b},
+            "out": {
+                "kernel": _np(
+                    sd[f"{p}.attention.output.dense.weight"]
+                ).T.reshape(h, dh, d),
+                "bias": _np(sd[f"{p}.attention.output.dense.bias"]),
+            },
+            "ln_attn": ln(f"{p}.attention.output.LayerNorm"),
+            "mlp_fc": {
+                "kernel": _np(sd[f"{p}.intermediate.dense.weight"]).T,
+                "bias": _np(sd[f"{p}.intermediate.dense.bias"]),
+            },
+            "mlp_proj": {
+                "kernel": _np(sd[f"{p}.output.dense.weight"]).T,
+                "bias": _np(sd[f"{p}.output.dense.bias"]),
+            },
+            "ln_mlp": ln(f"{p}.output.LayerNorm"),
+        }
+    if "cls.predictions.transform.dense.weight" in state_dict:
+        params["mlm_head"] = {
+            "transform": {
+                "kernel": _np(
+                    state_dict["cls.predictions.transform.dense.weight"]
+                ).T,
+                "bias": _np(state_dict["cls.predictions.transform.dense.bias"]),
+            },
+            "ln": {
+                "scale": _np(
+                    state_dict["cls.predictions.transform.LayerNorm.weight"]
+                ),
+                "bias": _np(
+                    state_dict["cls.predictions.transform.LayerNorm.bias"]
+                ),
+            },
+            "bias": _np(state_dict["cls.predictions.bias"]),
+        }
+    return params
+
+
+def bert_params_to_hf(params, *, depth: int) -> dict:
+    """Inverse of :func:`bert_params_from_hf`: ``Bert`` params → a state
+    dict loadable by HF ``BertForMaskedLM.load_state_dict(strict=False)``
+    (strict=False for HF's position_ids buffer and the pooler, which the
+    MLM model doesn't train)."""
+    from flax import linen as nn
+
+    p = nn.meta.unbox(params)
+    wte = _np(p["wte"])
+    d = wte.shape[1]
+    sd = {
+        "bert.embeddings.word_embeddings.weight": wte,
+        "bert.embeddings.position_embeddings.weight": _np(p["wpe"]),
+        "bert.embeddings.token_type_embeddings.weight": _np(p["wty"]),
+        "bert.embeddings.LayerNorm.weight": _np(p["ln_embed"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": _np(p["ln_embed"]["bias"]),
+        "cls.predictions.decoder.weight": wte,  # tied
+    }
+    for i in range(depth):
+        blk = p[f"h_{i}"]
+        o = f"bert.encoder.layer.{i}"
+        qkv_k = _np(blk["qkv"]["kernel"])  # [D, 3, H, dh]
+        qkv_b = _np(blk["qkv"]["bias"])    # [3, H, dh]
+        for j, n in enumerate(("query", "key", "value")):
+            sd[f"{o}.attention.self.{n}.weight"] = qkv_k[:, j].reshape(d, d).T
+            sd[f"{o}.attention.self.{n}.bias"] = qkv_b[j].reshape(d)
+        sd[f"{o}.attention.output.dense.weight"] = (
+            _np(blk["out"]["kernel"]).reshape(d, d).T
+        )
+        sd[f"{o}.attention.output.dense.bias"] = _np(blk["out"]["bias"])
+        sd[f"{o}.attention.output.LayerNorm.weight"] = _np(blk["ln_attn"]["scale"])
+        sd[f"{o}.attention.output.LayerNorm.bias"] = _np(blk["ln_attn"]["bias"])
+        sd[f"{o}.intermediate.dense.weight"] = _np(blk["mlp_fc"]["kernel"]).T
+        sd[f"{o}.intermediate.dense.bias"] = _np(blk["mlp_fc"]["bias"])
+        sd[f"{o}.output.dense.weight"] = _np(blk["mlp_proj"]["kernel"]).T
+        sd[f"{o}.output.dense.bias"] = _np(blk["mlp_proj"]["bias"])
+        sd[f"{o}.output.LayerNorm.weight"] = _np(blk["ln_mlp"]["scale"])
+        sd[f"{o}.output.LayerNorm.bias"] = _np(blk["ln_mlp"]["bias"])
+    if "mlm_head" in p:
+        head = p["mlm_head"]
+        sd["cls.predictions.transform.dense.weight"] = (
+            _np(head["transform"]["kernel"]).T
+        )
+        sd["cls.predictions.transform.dense.bias"] = _np(head["transform"]["bias"])
+        sd["cls.predictions.transform.LayerNorm.weight"] = _np(head["ln"]["scale"])
+        sd["cls.predictions.transform.LayerNorm.bias"] = _np(head["ln"]["bias"])
+        sd["cls.predictions.bias"] = _np(head["bias"])
+        sd["cls.predictions.decoder.bias"] = _np(head["bias"])
+    return sd
+
+
 def load_hf_params(
     path, *, arch: str, depth: int, num_heads: int,
     num_kv_heads: int | None = None,
@@ -177,7 +310,9 @@ def load_hf_params(
         return llama_params_from_hf(
             sd, depth=depth, num_heads=num_heads, num_kv_heads=num_kv_heads
         )
-    raise ValueError(f"unknown arch {arch!r} (want gpt2 or llama)")
+    if arch == "bert":
+        return bert_params_from_hf(sd, depth=depth, num_heads=num_heads)
+    raise ValueError(f"unknown arch {arch!r} (want gpt2, llama, or bert)")
 
 
 def save_hf_checkpoint(params, path, *, arch: str, depth: int) -> None:
@@ -193,8 +328,10 @@ def save_hf_checkpoint(params, path, *, arch: str, depth: int) -> None:
         sd = gpt2_params_to_hf(params, depth=depth)
     elif arch == "llama":
         sd = llama_params_to_hf(params, depth=depth)
+    elif arch == "bert":
+        sd = bert_params_to_hf(params, depth=depth)
     else:
-        raise ValueError(f"unknown arch {arch!r} (want gpt2 or llama)")
+        raise ValueError(f"unknown arch {arch!r} (want gpt2, llama, or bert)")
     os.makedirs(path, exist_ok=True)
     save_file(
         {k: np.ascontiguousarray(v) for k, v in sd.items()},

@@ -74,7 +74,9 @@ class EncoderBlock(nn.Module):
             kernel_init=_partitioned(dense_init, None, TENSOR_AXIS),
             bias_init=_partitioned(nn.initializers.zeros_init(), TENSOR_AXIS),
         )(x)
-        y = nn.gelu(y)
+        # exact (erf) GELU — BERT's convention, and what HF BertForMaskedLM
+        # computes; the tanh approximation is GPT-2's flavor
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(
             d, dtype=self.dtype, name="mlp_proj",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None),
@@ -96,7 +98,7 @@ class MlmHead(nn.Module):
     def __call__(self, x, wte):
         d = wte.shape[1]
         y = nn.Dense(d, dtype=self.dtype, name="transform")(x)
-        y = nn.gelu(y)
+        y = nn.gelu(y, approximate=False)  # erf GELU, the BERT convention
         y = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln")(y)
         logits = jnp.einsum(
             "...d,vd->...v", y, wte.astype(self.dtype),
