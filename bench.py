@@ -281,6 +281,48 @@ def bench_gpt2() -> None:
     )
 
 
+def bench_vit() -> None:
+    """BASELINE.json config 4: ViT-B/16 on ImageNet shapes, DP + bf16.
+    Target in the same spirit as the others — 90% of the reference STACK's
+    per-chip rate: eager PyTorch DDP (no torch.compile, no flash) trains
+    ViT-B/16 AMP at ~780 img/s on one A100 → target 700 img/s/chip. The
+    step itself runs at ~90% of its HBM roofline (docs/PERF.md §6)."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models import vit_b16
+    from tpudist.train import create_train_state, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    per_chip_batch = 128
+    batch = per_chip_batch * n_chips
+
+    model = vit_b16(dtype=jnp.bfloat16)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 224, 224, 3)), tx, mesh)
+    step = make_train_step(model, tx, mesh)
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    dev_batch = step.stage({
+        "image": rng.random((batch, 224, 224, 3), np.float32),
+        "label": rng.integers(0, 1000, batch).astype(np.int32),
+    })
+    for _ in range(3):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["loss"])
+    n_steps = 30
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, dev_batch)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    _emit(
+        "vit_b16_train_images_per_sec_per_chip",
+        batch * n_steps / dt / n_chips,
+        "images/sec/chip (bf16, batch 128/chip, 224x224, patch 16)",
+        700.0,
+    )
+
+
 def bench_gpt2_long_context() -> None:
     """Long-context leg: GPT-2 124M at seq 4096, Pallas flash attention vs
     the XLA einsum oracle on the identical step. ``vs_baseline`` here is the
@@ -364,6 +406,7 @@ def _run_with_retry(fn) -> None:
 
 def main() -> None:
     _run_with_retry(bench_resnet)
+    _run_with_retry(bench_vit)
     _run_with_retry(bench_gpt2)
     _run_with_retry(bench_gpt2_long_context)
 
