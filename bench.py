@@ -18,7 +18,9 @@ Legs
    reference's clock includes (/root/reference/main.py:95-111, which times
    the in-loop H2D staging) and proves the prefetch queue hides the input
    pipeline; a data-bound regression shows up as e2e ≪ device-only.
-3. ``gpt2_124m_tokens_per_sec_per_chip`` — BASELINE.json config 5: GPT-2
+3. ``vit_b16_train_images_per_sec_per_chip`` — BASELINE.json config 4:
+   ViT-B/16 at ImageNet shapes, DP + bf16 (docs/PERF.md §6).
+4. ``gpt2_124m_tokens_per_sec_per_chip`` — BASELINE.json config 5: GPT-2
    124M (768/12/12, seq 1024, full 50257 vocab), DP + gradient accumulation
    (2 microbatches × 8/chip), bf16 compute, chunked CE so the [B,S,V] fp32
    logits never materialize, XLA fused attention (measured faster than the
@@ -26,11 +28,18 @@ Legs
    layers: the axon remote-compile tunnel cannot compile the nn.scan'd step
    at this shape (docs/LM_TRAINING.md §3.6); a local-libtpu TPU VM can use
    ``scan_layers`` identically.
+5. ``gpt2_124m_e2e_tokens_per_sec_per_chip`` — the same step driven
+   through TokenWindowLoader → prefetch → stage (fit()'s data path).
+6. ``gpt2_124m_s4096_flash_tokens_per_sec_per_chip`` — long context:
+   seq 4096 with the Pallas flash kernel; vs_baseline is the speedup over
+   the identical XLA-attention step.
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
 - ResNet-50: 2250 img/s/chip = 90% of ~2500 img/s for one A100 running
   ResNet-50 mixed precision.
+- ViT-B/16: 700 img/s/chip = 90% of ~780 img/s for one A100 running
+  eager AMP ViT-B/16.
 - GPT-2 124M: 50k tok/s/chip = 90% of ~55k tokens/s for one A100 running
   the reference's eager-DDP stack (no torch.compile, no flash kernel) on
   the same model/seq-len.

@@ -11,11 +11,14 @@ from tpudist.models.gpt2 import GPT2, gpt2_124m, gpt2_medium, gpt2_large
 from tpudist.models.llama import (
     Llama, llama_125m, llama2_7b, llama3_8b, mixtral_8x7b,
 )
-from tpudist.models.bert import Bert, bert_base, bert_large
+from tpudist.models.bert import (
+    Bert, BertClassifier, bert_base, bert_large, classifier_params_from_mlm,
+)
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "ViT", "vit_b16", "GPT2", "gpt2_124m", "gpt2_medium", "gpt2_large",
     "Llama", "llama_125m", "llama2_7b", "llama3_8b", "mixtral_8x7b",
-    "Bert", "bert_base", "bert_large",
+    "Bert", "BertClassifier", "bert_base", "bert_large",
+    "classifier_params_from_mlm",
 ]

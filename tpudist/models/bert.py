@@ -163,6 +163,69 @@ class Bert(nn.Module):
         return MlmHead(dtype=self.dtype, name="mlm_head")(x, wte)
 
 
+class BertClassifier(nn.Module):
+    """Sequence classification on the encoder — the fine-tuning surface.
+
+    BERT's recipe: the first token's hidden state through the tanh pooler,
+    then a ``num_labels`` head. The encoder lives under the ``bert`` param
+    scope so :func:`classifier_params_from_mlm` can graft pretrained
+    weights (from :class:`Bert` MLM pretraining or an HF import) leaf-for-
+    leaf into a fresh classifier tree.
+    """
+
+    num_labels: int
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    type_vocab: int = 2
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, token_types=None):
+        hidden = Bert(
+            vocab_size=self.vocab_size, max_seq_len=self.max_seq_len,
+            hidden_dim=self.hidden_dim, depth=self.depth,
+            num_heads=self.num_heads, type_vocab=self.type_vocab,
+            dtype=self.dtype, attn_impl=self.attn_impl,
+            dropout=self.dropout, name="bert",
+        )(tokens, train=train, return_hidden=True, token_types=token_types)
+        pooled = jnp.tanh(
+            nn.Dense(self.hidden_dim, dtype=self.dtype, name="pooler")(
+                hidden[:, 0]
+            )
+        )
+        if self.dropout:
+            pooled = nn.Dropout(self.dropout, deterministic=not train)(pooled)
+        # fp32 head: classification logits are cheap and the loss is
+        # precision-sensitive
+        return nn.Dense(self.num_labels, dtype=jnp.float32, name="classifier")(
+            pooled
+        )
+
+
+def classifier_params_from_mlm(classifier_params, pretrained):
+    """Graft a pretrained encoder (MLM params, tpudist or HF-imported) into
+    a freshly-initialized :class:`BertClassifier` tree: every encoder leaf
+    is replaced, the pooler/classifier head keeps its fresh init (HF's
+    fine-tuning convention). ``mlm_head`` is dropped."""
+    import jax
+
+    encoder = {k: v for k, v in pretrained.items() if k != "mlm_head"}
+    out = dict(classifier_params)
+    # leaf-for-leaf replacement with a structure check: a geometry mismatch
+    # fails loudly instead of training a half-grafted model
+    out["bert"] = jax.tree_util.tree_map(
+        lambda fresh, pre: pre.astype(fresh.dtype)
+        if hasattr(pre, "astype") else pre,
+        dict(classifier_params["bert"]), encoder,
+    )
+    return out
+
+
 def bert_base(**kw) -> Bert:
     return Bert(**kw)
 
