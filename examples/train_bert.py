@@ -60,6 +60,17 @@ def parse_args(argv=None):
                    "size (bounds the [B,S,V] logits)")
     p.add_argument("--tensor", default=1, type=int,
                    help="Megatron TP degree over the 'tensor' mesh axis")
+    p.add_argument("--cp", default=1, type=int,
+                   help="context-parallel degree over the 'seq' mesh axis "
+                   "(pair with --attn ring/ulysses/ulysses_flash)")
+    p.add_argument("--attn", default="xla",
+                   choices=["xla", "flash", "ring", "ulysses", "ulysses_flash"])
+    p.add_argument("--scan_layers", action="store_true",
+                   help="nn.scan the depth (one traced layer; params stack "
+                   "[depth, ...])")
+    p.add_argument("--remat_layers", action="store_true",
+                   help="checkpoint each scanned layer (requires "
+                   "--scan_layers)")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--JobID", default="Bert0", type=str)
     p.add_argument("--log_dir", default=".", type=str)
@@ -99,9 +110,14 @@ def main(argv=None):
 
     ctx = init_from_env()
     mesh = mesh_lib.create_mesh(
-        mesh_lib.MeshConfig(data=-1, tensor=args.tensor)
+        mesh_lib.MeshConfig(data=-1, tensor=args.tensor, seq=args.cp)
     )
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    if args.cp > 1 and args.attn not in ("ring", "ulysses", "ulysses_flash"):
+        raise SystemExit(
+            "--cp needs a sequence-parallel attention: "
+            "--attn ring|ulysses|ulysses_flash"
+        )
 
     if args.mask_id is None:
         mask_id, model_vocab = args.vocab_size, args.vocab_size + 1
@@ -112,10 +128,19 @@ def main(argv=None):
             )
         mask_id, model_vocab = args.mask_id, args.vocab_size
 
+    if args.remat_layers and not args.scan_layers:
+        raise SystemExit("--remat_layers requires --scan_layers")
+    if args.scan_layers and args.init_hf:
+        raise SystemExit(
+            "--init_hf uses the unrolled layout; convert with "
+            "tpudist.models.lm_utils.stack_layers or drop --scan_layers"
+        )
     model = Bert(
         vocab_size=model_vocab, max_seq_len=args.seq_len,
         hidden_dim=args.hidden_dim, depth=args.depth,
         num_heads=args.num_heads, dtype=dtype,
+        attn_impl=args.attn, mesh=mesh,
+        scan_layers=args.scan_layers, remat_layers=args.remat_layers,
     )
 
     local_replicas = max(
@@ -157,6 +182,20 @@ def main(argv=None):
             num_heads=args.num_heads,
         )
 
+    batch_spec = None
+    if args.cp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        bd = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+        # every [B, S] key rides sequence-sharded; grad accumulation adds
+        # the leading (replicated) microbatch dim
+        spec = (
+            P(bd, mesh_lib.SEQUENCE_AXIS)
+            if args.grad_accum == 1
+            else P(None, bd, mesh_lib.SEQUENCE_AXIS)
+        )
+        batch_spec = {"tokens": spec, "targets": spec, "mlm_mask": spec}
+
     dp_size = mesh_lib.data_parallel_size(mesh)
     t0 = time.time()
     state, losses = fit(
@@ -166,7 +205,7 @@ def main(argv=None):
         world_size=dp_size, global_rank=ctx.process_index,
         input_key="tokens", label_key="targets",
         forward_loss=mlm_forward(model, chunk=args.chunked_ce or None),
-        grad_accum=args.grad_accum,
+        grad_accum=args.grad_accum, batch_spec=batch_spec,
         profile=not args.no_profiler, log_dir=args.log_dir,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

@@ -41,6 +41,7 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
     dropout: float = 0.0
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -61,7 +62,32 @@ class EncoderBlock(nn.Module):
             ),
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = multi_head_attention(q, k, v, causal=False, impl=self.attn_impl)
+        if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
+            # context-parallel bidirectional attention over the 'seq' mesh
+            # axis (tpudist.parallel.cp, causal=False) — long-document
+            # encoder training with sequence-sharded activations
+            if self.mesh is None:
+                raise ValueError(
+                    f"attn_impl={self.attn_impl!r} needs the model's mesh= "
+                    "field set (the shard_map runs over its 'seq' axis)"
+                )
+            from tpudist.parallel.cp import ring_attention, ulysses_attention
+
+            if self.attn_impl == "ring":
+                attn = ring_attention(q, k, v, self.mesh, causal=False)
+            else:
+                attn_fn = None
+                if self.attn_impl == "ulysses_flash":
+                    from tpudist.ops.flash_attention import flash_attention
+
+                    attn_fn = flash_attention
+                attn = ulysses_attention(
+                    q, k, v, self.mesh, causal=False, attn_fn=attn_fn
+                )
+        else:
+            attn = multi_head_attention(
+                q, k, v, causal=False, impl=self.attn_impl
+            )
         y = nn.DenseGeneral(
             d, axis=(-2, -1), dtype=self.dtype, name="out",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
@@ -110,6 +136,26 @@ class MlmHead(nn.Module):
         return logits + bias
 
 
+class _CarryEncoderBlock(nn.Module):
+    """:class:`EncoderBlock` with the (carry, xs) → (carry, ys) signature
+    ``nn.scan`` maps over (``train`` rides as a field)."""
+
+    num_heads: int
+    train: bool = True
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    mesh: Any = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, _):
+        x = EncoderBlock(
+            self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
+            mesh=self.mesh, dropout=self.dropout, name="block",
+        )(x, train=self.train)
+        return x, None
+
+
 class Bert(nn.Module):
     vocab_size: int = 30522
     max_seq_len: int = 512
@@ -120,6 +166,12 @@ class Bert(nn.Module):
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
     dropout: float = 0.0
+    mesh: Any = None
+    # scan_layers/remat_layers: nn.scan'd depth with optional per-layer
+    # checkpointing — same fields and semantics as the decoder families
+    # (one traced layer at any depth; params stack [depth, ...])
+    scan_layers: bool = False
+    remat_layers: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
@@ -153,11 +205,36 @@ class Bert(nn.Module):
         )(x.astype(self.dtype))
         if self.dropout:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        for i in range(self.depth):
-            x = EncoderBlock(
-                self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
-                dropout=self.dropout, name=f"h_{i}",
-            )(x, train=train)
+        if self.scan_layers:
+            body = (
+                nn.remat(_CarryEncoderBlock)
+                if self.remat_layers else _CarryEncoderBlock
+            )
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.depth,
+                # stacked depth axis carries no partition name (unsharded);
+                # per-layer TENSOR_AXIS metadata shifts right intact
+                metadata_params={nn.PARTITION_NAME: None},
+            )(
+                num_heads=self.num_heads, train=train, dtype=self.dtype,
+                attn_impl=self.attn_impl, mesh=self.mesh,
+                dropout=self.dropout, name="hs",
+            )
+            x, _ = scanned(x, None)
+        elif self.remat_layers:
+            raise ValueError("remat_layers requires scan_layers=True "
+                             "(use make_train_step(remat=True) to checkpoint "
+                             "an unrolled forward)")
+        else:
+            for i in range(self.depth):
+                x = EncoderBlock(
+                    self.num_heads, dtype=self.dtype,
+                    attn_impl=self.attn_impl, mesh=self.mesh,
+                    dropout=self.dropout, name=f"h_{i}",
+                )(x, train=train)
         if return_hidden:
             return x
         return MlmHead(dtype=self.dtype, name="mlm_head")(x, wte)
