@@ -375,3 +375,32 @@ def test_scan_layers_trains_with_stacked_params():
     batch = mlm_transform(vocab_size=97, mask_id=3, seed=6)({"tokens": tokens})
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_stack_layers_converts_unrolled_bert_to_scanned():
+    """The shared stack_layers converter (lm_utils) moves an unrolled BERT
+    checkpoint into the scan layout: identical logits from both models."""
+    from flax import linen as nn
+
+    from tpudist.models.lm_utils import stack_layers, unstack_layers
+
+    kw = dict(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=3,
+              num_heads=4)
+    rng = np.random.Generator(np.random.PCG64(17))
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    unrolled = Bert(**kw)
+    params = nn.meta.unbox(
+        unrolled.init(jax.random.key(5), tokens, train=False)["params"]
+    )
+    want = unrolled.apply({"params": params}, tokens, train=False)
+
+    stacked = stack_layers(params, 3, prefix="h_", dest="hs")
+    scanned = Bert(scan_layers=True, **kw)
+    got = scanned.apply({"params": stacked}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    # and back
+    back = unstack_layers(stacked, prefix="h_", dest="hs")
+    again = unrolled.apply({"params": back}, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(again), np.asarray(want), rtol=1e-6)
