@@ -404,3 +404,104 @@ def test_stack_layers_converts_unrolled_bert_to_scanned():
     back = unstack_layers(stacked, prefix="h_", dest="hs")
     again = unrolled.apply({"params": back}, tokens, train=False)
     np.testing.assert_allclose(np.asarray(again), np.asarray(want), rtol=1e-6)
+
+
+def test_attention_mask_excludes_padding():
+    """A right-padded batch with attention_mask must produce the SAME hidden
+    states on the real positions as the unpadded sequence: padded keys are
+    out of every softmax, so position i's context is identical either way."""
+    model = tiny_bert()
+    rng = np.random.Generator(np.random.PCG64(21))
+    short = rng.integers(0, 97, (2, 12)).astype(np.int32)
+    params = model.init(jax.random.key(0), jnp.asarray(short), train=False)[
+        "params"
+    ]
+    base = model.apply({"params": params}, jnp.asarray(short), train=False)
+    # pad with junk ids the model HAS embeddings for — the mask, not the pad
+    # value, must make them inert
+    padded = np.concatenate(
+        [short, rng.integers(0, 97, (2, 4)).astype(np.int32)], axis=1
+    )
+    mask = np.zeros((2, 16), np.int32)
+    mask[:, :12] = 1
+    out = model.apply(
+        {"params": params}, jnp.asarray(padded), train=False,
+        attention_mask=jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[:, :12]), np.asarray(base), rtol=2e-5, atol=2e-5
+    )
+    # and without the mask the junk keys must bleed in (the failure the
+    # mask exists to prevent)
+    unmasked = model.apply({"params": params}, jnp.asarray(padded), train=False)
+    assert not np.allclose(np.asarray(unmasked[:, :12]), np.asarray(base))
+
+
+def test_attention_mask_scan_layers_matches_unrolled():
+    """The mask rides nn.scan as a broadcast argument; scanned and unrolled
+    layouts must agree on masked inputs (same per-layer params via
+    stack_layers would be overkill — equality of masked-vs-short suffices)."""
+    model = tiny_bert(depth=3, scan_layers=True)
+    rng = np.random.Generator(np.random.PCG64(22))
+    short = rng.integers(0, 97, (1, 10)).astype(np.int32)
+    params = model.init(jax.random.key(1), jnp.asarray(short), train=False)[
+        "params"
+    ]
+    base = model.apply({"params": params}, jnp.asarray(short), train=False)
+    padded = np.concatenate(
+        [short, rng.integers(0, 97, (1, 6)).astype(np.int32)], axis=1
+    )
+    mask = np.zeros((1, 16), np.int32)
+    mask[:, :10] = 1
+    out = model.apply(
+        {"params": params}, jnp.asarray(padded), train=False,
+        attention_mask=jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[:, :10]), np.asarray(base), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_classifier_accepts_attention_mask():
+    from tpudist.models.bert import BertClassifier
+
+    model = BertClassifier(
+        num_labels=3, vocab_size=97, max_seq_len=32, hidden_dim=32,
+        depth=2, num_heads=4,
+    )
+    rng = np.random.Generator(np.random.PCG64(23))
+    short = rng.integers(0, 97, (2, 9)).astype(np.int32)
+    variables = model.init(jax.random.key(0), jnp.asarray(short), train=False)
+    base = model.apply(variables, jnp.asarray(short), train=False)
+    padded = np.concatenate(
+        [short, rng.integers(0, 97, (2, 7)).astype(np.int32)], axis=1
+    )
+    mask = np.zeros((2, 16), np.int32)
+    mask[:, :9] = 1
+    out = model.apply(
+        variables, jnp.asarray(padded), train=False,
+        attention_mask=jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(base), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_mlm_random_replacement_never_injects_mask_id():
+    """The 10% random-token replacement draws from the vocab EXCLUDING
+    [MASK]: a random draw landing on mask_id would create a target-bearing
+    position the model can only see as masked (ADVICE r2)."""
+    rng = np.random.Generator(np.random.PCG64(24))
+    tokens = rng.integers(0, 5, (512, 64)).astype(np.int32)
+    # random_rate=1.0: every selected position becomes a random token, so a
+    # single mask_id anywhere among them is the bug
+    tr = mlm_transform(
+        vocab_size=5, mask_id=3, random_rate=1.0, keep_rate=0.0, seed=0
+    )
+    out = tr({"tokens": tokens})
+    sel = out["mlm_mask"]
+    assert sel.sum() > 1000
+    replaced = out["tokens"][sel]
+    assert not (replaced == 3).any(), "random replacement produced [MASK]"
+    # the other ids all remain reachable
+    assert set(np.unique(replaced)) == {0, 1, 2, 4}

@@ -120,6 +120,39 @@ def test_max_restarts_recovers_transient_failure(tmp_path):
     assert (tmp_path / "crashed_once").exists()
 
 
+def test_sigterm_suppresses_restart(tmp_path):
+    """SIGTERM to the LAUNCHER (scheduler preemption / supervisor stop) must
+    shut the world down without burning restart attempts: the children's
+    resulting non-zero exits are launcher-initiated, not failures."""
+    import signal
+
+    script = tmp_path / "child.py"
+    script.write_text("import time; time.sleep(60)\n")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpudist.launch", "--nproc_per_node=2",
+         "--max_restarts=5", str(script)],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    # wait until both children actually exist (a fixed sleep races handler
+    # installation on a loaded machine)
+    for _ in range(100):
+        ps = subprocess.run(
+            ["ps", "--ppid", str(p.pid), "-o", "pid="],
+            capture_output=True, text=True,
+        )
+        if len(ps.stdout.split()) >= 2:
+            break
+        time.sleep(0.2)
+    p.send_signal(signal.SIGTERM)
+    try:
+        _, err = p.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        raise AssertionError("launcher kept restarting after SIGTERM")
+    assert "restarting" not in err, err
+
+
 def test_max_restarts_exhausted_reports_failure(tmp_path):
     body = textwrap.dedent("""
         import sys

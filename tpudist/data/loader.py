@@ -162,13 +162,15 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
     thread.start()
 
     def _next_host():
+        """Next host item, the producer's error object, or DONE. Producer
+        errors are RETURNED (so the consumer can defer them behind staged
+        batches); exceptions raised here — e.g. a KeyboardInterrupt during
+        the wait — propagate immediately."""
         with lock:
             while not host_q:
                 lock.wait()
             item = host_q.popleft()
             lock.notify_all()
-        if isinstance(item, BaseException):
-            raise item
         return item
 
     if stage_fn is None:
@@ -176,16 +178,27 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
 
     try:
         finished = False
+        pending_err: BaseException | None = None
         while True:
-            while not finished and len(queue) < depth:
+            while not finished and pending_err is None and len(queue) < depth:
                 item = _next_host()
                 if item is DONE:
                     finished = True
+                elif isinstance(item, BaseException):
+                    # deliver every batch staged BEFORE the loader died, then
+                    # the error — the already-good work (e.g. a step that
+                    # crosses a checkpoint boundary) isn't discarded with it.
+                    # Only producer-delivered errors defer; a KeyboardInterrupt
+                    # in THIS thread propagates from _next_host immediately.
+                    pending_err = item
                 else:
                     queue.append(stage_fn(item))
-            if not queue:
+            if queue:
+                yield queue.popleft()
+            elif pending_err is not None:
+                raise pending_err
+            else:
                 return
-            yield queue.popleft()
     finally:
         # unblock and retire the producer if the consumer bailed mid-epoch
         with lock:

@@ -66,10 +66,27 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     attempt = 0
+    # one handler for the launcher's whole life, closing over the CURRENT
+    # generation's procs: a SIGTERM landing between generations (previous
+    # world dead, next one mid-spawn) still sets the stop flag and
+    # terminates whatever is alive, so the restart loop can never spawn or
+    # keep a world past an operator stop
+    stop = {"terminated": False, "procs": []}
+
+    def _kill(signum, frame):
+        stop["terminated"] = True
+        for p in stop["procs"]:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _kill)
     while True:
-        rc = _run_world(args)
-        # 130 = operator interrupt — never auto-restart over a Ctrl-C
-        if rc == 0 or rc == 130 or attempt >= args.max_restarts:
+        rc = _run_world(args, stop)
+        # never auto-restart over an operator stop: 130 = Ctrl-C, and a
+        # SIGTERM delivered to the launcher itself (scheduler preemption /
+        # supervisor shutdown) sets stop["terminated"] — the children's
+        # resulting non-zero exits are launcher-initiated, not failures
+        if rc == 0 or rc == 130 or stop["terminated"] or attempt >= args.max_restarts:
             return rc
         attempt += 1
         print(
@@ -79,11 +96,16 @@ def main(argv: list[str] | None = None) -> int:
         )
 
 
-def _run_world(args) -> int:
+def _run_world(args, stop: dict | None = None) -> int:
     """Spawn and supervise one generation of this node's processes."""
+    if stop is None:
+        stop = {"terminated": False, "procs": []}
     world_size = args.nnode * args.nproc_per_node
-    procs: list[subprocess.Popen] = []
+    procs: list[subprocess.Popen] = stop["procs"]
+    procs.clear()
     for local_rank in range(args.nproc_per_node):
+        if stop["terminated"]:
+            break  # operator stop arrived mid-spawn; don't widen the world
         rank = args.node_rank * args.nproc_per_node + local_rank
         env = dict(os.environ)
         env.update(
@@ -104,11 +126,6 @@ def _run_world(args) -> int:
         cmd = cmd + [args.script, f"--local_rank={local_rank}"] + args.script_args
         procs.append(subprocess.Popen(cmd, env=env))
 
-    def _kill(signum, frame):
-        for p in procs:
-            p.terminate()
-
-    signal.signal(signal.SIGTERM, _kill)
     rc = 0
     try:
         # poll all children: the first non-zero exit terminates the rest so
@@ -118,6 +135,13 @@ def _run_world(args) -> int:
 
         live = list(procs)
         while live:
+            if stop["terminated"]:
+                # operator stop may have raced a mid-Popen child past the
+                # handler's terminate sweep; re-sweep here so no child
+                # outlives the stop
+                for q in live:
+                    if q.poll() is None:
+                        q.terminate()
             for p in list(live):
                 code = p.poll()
                 if code is None:
@@ -130,7 +154,9 @@ def _run_world(args) -> int:
             if live:
                 _time.sleep(0.2)
     except KeyboardInterrupt:
-        _kill(None, None)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
         for p in procs:
             p.wait()
         rc = 130

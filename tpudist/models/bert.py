@@ -44,7 +44,7 @@ class EncoderBlock(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, attention_mask=None):
         b, s, d = x.shape
         h = self.num_heads
         drop = lambda y: (
@@ -66,6 +66,13 @@ class EncoderBlock(nn.Module):
             # context-parallel bidirectional attention over the 'seq' mesh
             # axis (tpudist.parallel.cp, causal=False) — long-document
             # encoder training with sequence-sharded activations
+            if attention_mask is not None:
+                raise ValueError(
+                    f"attention_mask is not supported with attn_impl="
+                    f"{self.attn_impl!r} (the context-parallel paths assume "
+                    "dense fixed-length windows); pad-free batches or the "
+                    "xla/flash impls"
+                )
             if self.mesh is None:
                 raise ValueError(
                     f"attn_impl={self.attn_impl!r} needs the model's mesh= "
@@ -85,8 +92,16 @@ class EncoderBlock(nn.Module):
                     q, k, v, self.mesh, causal=False, attn_fn=attn_fn
                 )
         else:
+            # [b, s] key-padding mask (1 = real token) → broadcast over
+            # heads and query positions: padded KEYS are excluded from every
+            # softmax; padded query rows produce garbage that downstream
+            # consumers never read (BERT reads [CLS] / masked positions only)
+            key_mask = (
+                None if attention_mask is None
+                else attention_mask[:, None, None, :].astype(bool)
+            )
             attn = multi_head_attention(
-                q, k, v, causal=False, impl=self.attn_impl
+                q, k, v, causal=False, mask=key_mask, impl=self.attn_impl
             )
         y = nn.DenseGeneral(
             d, axis=(-2, -1), dtype=self.dtype, name="out",
@@ -148,11 +163,11 @@ class _CarryEncoderBlock(nn.Module):
     dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, x, _):
+    def __call__(self, x, attention_mask):
         x = EncoderBlock(
             self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
             mesh=self.mesh, dropout=self.dropout, name="block",
-        )(x, train=self.train)
+        )(x, train=self.train, attention_mask=attention_mask)
         return x, None
 
 
@@ -175,7 +190,7 @@ class Bert(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 token_types=None):
+                 token_types=None, attention_mask=None):
         b, s = tokens.shape
         if s > self.max_seq_len:
             raise ValueError(
@@ -215,6 +230,8 @@ class Bert(nn.Module):
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=self.depth,
+                # the padding mask is layer-invariant: broadcast, not mapped
+                in_axes=nn.broadcast,
                 # stacked depth axis carries no partition name (unsharded);
                 # per-layer TENSOR_AXIS metadata shifts right intact
                 metadata_params={nn.PARTITION_NAME: None},
@@ -223,7 +240,7 @@ class Bert(nn.Module):
                 attn_impl=self.attn_impl, mesh=self.mesh,
                 dropout=self.dropout, name="hs",
             )
-            x, _ = scanned(x, None)
+            x, _ = scanned(x, attention_mask)
         elif self.remat_layers:
             raise ValueError("remat_layers requires scan_layers=True "
                              "(use make_train_step(remat=True) to checkpoint "
@@ -234,7 +251,7 @@ class Bert(nn.Module):
                     self.num_heads, dtype=self.dtype,
                     attn_impl=self.attn_impl, mesh=self.mesh,
                     dropout=self.dropout, name=f"h_{i}",
-                )(x, train=train)
+                )(x, train=train, attention_mask=attention_mask)
         if return_hidden:
             return x
         return MlmHead(dtype=self.dtype, name="mlm_head")(x, wte)
@@ -262,14 +279,19 @@ class BertClassifier(nn.Module):
     dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, token_types=None):
+    def __call__(self, tokens, train: bool = True, token_types=None,
+                 attention_mask=None):
+        # attention_mask ([b, s], 1 = real token): padded variable-length
+        # classification batches must pass it, or pad tokens join every
+        # softmax (HF BERT semantics require the mask — ADVICE r2)
         hidden = Bert(
             vocab_size=self.vocab_size, max_seq_len=self.max_seq_len,
             hidden_dim=self.hidden_dim, depth=self.depth,
             num_heads=self.num_heads, type_vocab=self.type_vocab,
             dtype=self.dtype, attn_impl=self.attn_impl,
             dropout=self.dropout, name="bert",
-        )(tokens, train=train, return_hidden=True, token_types=token_types)
+        )(tokens, train=train, return_hidden=True, token_types=token_types,
+          attention_mask=attention_mask)
         pooled = jnp.tanh(
             nn.Dense(self.hidden_dim, dtype=self.dtype, name="pooler")(
                 hidden[:, 0]
@@ -342,9 +364,11 @@ def mlm_transform(
         to_mask = selected & ~to_random & ~to_keep
         corrupted = tokens.copy()
         corrupted[to_mask] = mask_id
-        corrupted[to_random] = rng.integers(
-            0, vocab_size, int(to_random.sum())
-        )
+        # draw "random token" from the vocab EXCLUDING mask_id: draw over
+        # vocab_size-1 ids and shift the ones at/above mask_id up by one, so
+        # [MASK] can never appear as a target-bearing random id (ADVICE r2)
+        draw = rng.integers(0, vocab_size - 1, int(to_random.sum()))
+        corrupted[to_random] = draw + (draw >= mask_id)
         out = dict(batch)
         out[key] = corrupted
         out["targets"] = tokens

@@ -37,13 +37,24 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None):
 
 
 def multi_head_attention(q, k, v, *, causal: bool = False, mask=None, impl: str = "xla"):
-    if impl == "flash" and mask is None:
-        try:
-            from tpudist.ops.flash_attention import flash_attention
-
-            return flash_attention(q, k, v, causal=causal)
-        except (ImportError, NotImplementedError) as e:
+    if impl == "flash":
+        if mask is not None:
+            # no silent fallback: the caller picked flash to keep the S×S
+            # scores out of HBM, and a general mask forces the dense path
             import warnings
 
-            warnings.warn(f"flash attention unavailable ({e}); using XLA attention")
+            warnings.warn(
+                "flash attention takes no general mask; falling back to XLA "
+                "attention (S×S scores in HBM) — for contiguous key padding "
+                "use the kernel's kv_len instead"
+            )
+        else:
+            try:
+                from tpudist.ops.flash_attention import flash_attention
+
+                return flash_attention(q, k, v, causal=causal)
+            except (ImportError, NotImplementedError) as e:
+                import warnings
+
+                warnings.warn(f"flash attention unavailable ({e}); using XLA attention")
     return dot_product_attention(q, k, v, causal=causal, mask=mask)

@@ -501,42 +501,56 @@ def fit(
                 logger.log_step(g, loss_value, now - pstart)
                 logger.print_progress(pe, pidx, loss_value)
 
-            for e in range(start_epoch, epochs):
-                if hasattr(train_loader, "sampler"):
-                    train_loader.sampler.set_epoch(e)
-                first_idx = skip_batches if e == start_epoch else 0
-                # the sampler order is deterministic per epoch, so starting
-                # at the first unconsumed batch resumes mid-epoch at the
-                # exact position the checkpoint was taken; iter_from skips
-                # at the index level (no discarded gather/transform work),
-                # islice is the fallback for foreign loaders
-                if first_idx and hasattr(train_loader, "iter_from"):
-                    batches = train_loader.iter_from(first_idx)
-                elif first_idx:
-                    batches = itertools.islice(iter(train_loader), first_idx, None)
-                else:
-                    batches = iter(train_loader)
-                for idx, batch in enumerate(
-                    prefetch_to_mesh(
-                        batches, mesh,
-                        depth=prefetch_depth, stage_fn=step.stage,
-                    ),
-                    start=first_idx,
-                ):
-                    start = time.time()
-                    global_step += 1
-                    state, metrics = step(state, batch)
-                    loss_dev = metrics["loss"]
-                    loss_dev.copy_to_host_async()
-                    if pending is not None:
-                        resolve(start)
-                    pending = (global_step, e, idx, start, loss_dev)
-                    p.step()
-                    if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
-                        ckpt.save(state)
-            if pending is not None:
-                resolve(time.time())
-                pending = None
+            try:
+                for e in range(start_epoch, epochs):
+                    if hasattr(train_loader, "sampler"):
+                        train_loader.sampler.set_epoch(e)
+                    first_idx = skip_batches if e == start_epoch else 0
+                    # the sampler order is deterministic per epoch, so starting
+                    # at the first unconsumed batch resumes mid-epoch at the
+                    # exact position the checkpoint was taken; iter_from skips
+                    # at the index level (no discarded gather/transform work),
+                    # islice is the fallback for foreign loaders
+                    if first_idx and hasattr(train_loader, "iter_from"):
+                        batches = train_loader.iter_from(first_idx)
+                    elif first_idx:
+                        batches = itertools.islice(iter(train_loader), first_idx, None)
+                    else:
+                        batches = iter(train_loader)
+                    for idx, batch in enumerate(
+                        prefetch_to_mesh(
+                            batches, mesh,
+                            depth=prefetch_depth, stage_fn=step.stage,
+                        ),
+                        start=first_idx,
+                    ):
+                        start = time.time()
+                        global_step += 1
+                        state, metrics = step(state, batch)
+                        loss_dev = metrics["loss"]
+                        loss_dev.copy_to_host_async()
+                        if pending is not None:
+                            resolve(start)
+                        pending = (global_step, e, idx, start, loss_dev)
+                        p.step()
+                        if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
+                            ckpt.save(state)
+            except BaseException:
+                # flush the last completed step before the exception leaves:
+                # the loss history and TSV then end at the step that actually
+                # finished, not one row short — but never mask the original
+                # exception with a fetch failure (e.g. the device itself died)
+                if pending is not None:
+                    try:
+                        resolve(time.time())
+                    except Exception:
+                        pass
+                    pending = None
+                raise
+            else:
+                if pending is not None:
+                    resolve(time.time())
+                    pending = None
             if ckpt and global_step > start_step:
                 ckpt.save(state)
     finally:
@@ -547,20 +561,34 @@ def fit(
 
 def _padded_batches(loader, mesh: Mesh, key: str):
     """Yield ``(staged_batch, staged_row_mask, n_real_rows)`` with every
-    batch padded (repeating the last row) to the mesh's replica count and
-    the padding masked — the one home for the ragged-final-batch math that
-    both eval paths (:func:`evaluate`, :func:`evaluate_lm`) share."""
+    batch padded (repeating the last row) to one constant row count and the
+    padding masked — the one home for the ragged-final-batch math that both
+    eval paths (:func:`evaluate`, :func:`evaluate_lm`) share.
+
+    The pad target is the FIRST batch's row count (rounded up to the mesh's
+    replica count), not merely the replica multiple: a ragged tail padded
+    only to the replica count would present a new shape and trigger a fresh
+    jit compile per distinct tail size per call — harmless locally, minutes
+    per shape on a remote-compile attach. With a constant target the eval
+    program compiles exactly once; the mask keeps the accounting exact.
+    """
     dp = mesh_lib.data_parallel_size(mesh)
+    target = None
     for batch in loader:
         batch = {k: np.asarray(v) for k, v in batch.items()}
         n = batch[key].shape[0]
-        pad = -n % dp
+        if target is None:
+            target = n + (-n % dp)
+        # an oversize batch (foreign loader growing mid-stream) still pads to
+        # its own replica multiple — one extra compile, never an error
+        t = target if n <= target else n + (-n % dp)
+        pad = t - n
         if pad:
             batch = {
                 k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
                 for k, v in batch.items()
             }
-        mask = np.arange(n + pad) < n
+        mask = np.arange(t) < n
         batch = mesh_lib.shard_batch(batch, mesh)
         mask = mesh_lib.put_sharded(
             mask, mesh_lib.batch_sharding(mesh, extra_dims=0)
@@ -571,6 +599,7 @@ def _padded_batches(loader, mesh: Mesh, key: str):
 def evaluate_lm(
     model, state: TrainState, loader, mesh: Mesh | None = None,
     *, input_key: str = "tokens", chunk: int | None = None,
+    input_transform: Callable | None = None,
 ) -> dict[str, float]:
     """Next-token CE and perplexity over a token-window loader — the LM
     counterpart of :func:`evaluate` (the reference's eval loop is
@@ -587,6 +616,9 @@ def evaluate_lm(
     logits never materialize — pass it whenever training needed
     ``chunked_lm_forward`` for the same reason, or eval will re-create the
     very HBM peak the training path avoided.
+    ``input_transform`` mirrors :func:`make_train_step`'s hook (applied to
+    the model INPUT only, never the CE targets) so a model trained through
+    an in-graph transform evals through the same one.
     Returns ``{"loss": mean per-token CE, "perplexity": exp(loss)}``.
     """
     import math
@@ -599,8 +631,9 @@ def evaluate_lm(
         @jax.jit
         def batch_ce(params, batch, mask):
             tokens = batch[input_key]
+            inputs = tokens if input_transform is None else input_transform(tokens)
             hidden = model.apply(
-                {"params": params}, tokens, train=False, return_hidden=True
+                {"params": params}, inputs, train=False, return_hidden=True
             )
             b, s = tokens.shape
             ce_sum = chunked_ce_sum(
@@ -613,7 +646,8 @@ def evaluate_lm(
         @jax.jit
         def batch_ce(params, batch, mask):
             tokens = batch[input_key]
-            logits = model.apply({"params": params}, tokens, train=False)
+            inputs = tokens if input_transform is None else input_transform(tokens)
+            logits = model.apply({"params": params}, inputs, train=False)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tokens[:, 1:]
             )
@@ -636,7 +670,8 @@ def evaluate_lm(
 
 
 def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
-             *, input_key: str = "image", label_key: str = "label") -> float:
+             *, input_key: str = "image", label_key: str = "label",
+             input_transform: Callable | None = None) -> float:
     """Top-1 accuracy over a loader — the reference's dormant eval pass
     (/root/reference/main.py:119-130), alive and tested here.
 
@@ -657,7 +692,13 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
     @jax.jit
     def count_correct(params, batch_stats, batch, mask):
         variables = {"params": params, "batch_stats": batch_stats}
-        logits = model.apply(variables, batch[input_key], train=False)
+        inputs = batch[input_key]
+        if input_transform is not None:
+            # same in-graph hook as make_train_step: a model trained on
+            # device_normalize'd uint8 would otherwise silently score raw
+            # 0..255 inputs here (ADVICE r2)
+            inputs = input_transform(inputs)
+        logits = model.apply(variables, inputs, train=False)
         hit = jnp.argmax(logits, axis=-1) == batch[label_key]
         # the denominator comes from the SAME global mask as the numerator,
         # in-graph: correct whether each process feeds an identical full val
