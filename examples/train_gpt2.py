@@ -98,12 +98,13 @@ def parse_args(argv=None):
     p.add_argument("--experts", default=0, type=int, help="MoE experts (0=dense)")
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
-    p.add_argument("--attn", default="xla",
-                   choices=["auto", "xla", "flash", "ring", "ulysses",
+    p.add_argument("--attn", default="auto",
+                   choices=["auto", "xla", "vmem", "flash", "ring", "ulysses",
                             "ulysses_flash"],
-                   help="auto picks by context length: XLA's fused attention "
-                   "wins below ~2k (measured ~78k vs ~57k tok/s at 1024 on "
-                   "v5e), the flash kernel wins beyond (~14x at 8k)")
+                   help="auto picks by context length: the whole-sequence "
+                   "VMEM kernel wins up to 1k (measured 126k vs 80k tok/s "
+                   "at 1024 on v5e), the blockwise flash kernel wins beyond "
+                   "(~14x over XLA at 8k), XLA is the dense-mask oracle")
     p.add_argument("--init_hf", default=None, type=str,
                    help="warm-start from a LOCAL HF checkpoint dir/file "
                    "(*.safetensors or pytorch_model*.bin) converted via "
@@ -144,14 +145,27 @@ def token_source(args):
 
 def main(argv=None):
     args = parse_args(argv)
-    if args.attn == "auto":
-        args.attn = "xla" if args.seq_len < 2048 else "flash"
     if os.environ.get("TPUDIST_FORCE_CPU"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
 
     import jax
+
+    if args.attn == "auto":
+        # multi_head_attention(impl="auto") would route per-call; resolving
+        # here keeps the choice visible in the run's config echo. Matches
+        # attention.py's measured crossover (vmem ≤ 1024, dense XLA in the
+        # 1025–2047 window, flash from 2048). Off-TPU the Pallas kernels
+        # only run in interpret emulation, so CPU runs stay on XLA.
+        if jax.default_backend() != "tpu":
+            args.attn = "xla"
+        elif args.seq_len <= 1024:
+            args.attn = "vmem"
+        elif args.seq_len < 2048:
+            args.attn = "xla"
+        else:
+            args.attn = "flash"
     import jax.numpy as jnp
 
     from tpudist import init_from_env
