@@ -89,6 +89,13 @@ def parse_args(argv=None):
     parser.add_argument("--augment", action="store_true",
                         help="standard CIFAR augmentation (crop+flip+"
                         "normalize); reference default is ToTensor only")
+    parser.add_argument("--device_cache", action="store_true",
+                        help="stage the uint8 dataset to HBM once before "
+                        "compile and ship only sampler indices per step "
+                        "(tpudist/data/device_cache.py) — removes pixels "
+                        "from the step's H2D path; incompatible with "
+                        "--augment (host-side) and --dataset imagenet "
+                        "(streaming)")
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
     parser.add_argument("--checkpoint_dir", default=None, type=str,
@@ -144,6 +151,7 @@ def main(argv=None):
     # this process's loader yields batch_size × local replicas, and the mesh
     # assembles the global batch of batch_size × world_size
     per_process_batch = args.batch_size * jax.local_device_count()
+    input_transform = None  # set by the --device_cache path
 
     if args.dataset == "imagenet":
         # streaming image-folder pipeline (BASELINE configs 2/3): decode-on-
@@ -172,17 +180,39 @@ def main(argv=None):
             len(data["label"]), num_replicas=ctx.process_count,
             rank=ctx.process_index,
         )
-        if args.augment:
+        if args.device_cache:
+            if args.augment:
+                raise SystemExit(
+                    "--device_cache gathers in-graph; host-side --augment "
+                    "does not apply (drop one of the two)"
+                )
+            from tpudist.data.device_cache import DeviceCachedLoader
+
+            # staged HERE — before create_train_state compiles anything —
+            # so the one-time H2D rides the fast pre-compile link on
+            # remote attaches (docs/PERF.md §3b)
+            loader = DeviceCachedLoader(
+                data, per_process_batch, mesh=mesh, sampler=sampler
+            )
+            # in-graph ToTensor (uint8 → [0,1] float), the reference's
+            # transform (main.py:46) moved into the compiled step
+            input_transform = loader.input_transform(
+                lambda x: x.astype(dtype) / 255.0
+            )
+        elif args.augment:
             from tpudist.data.transforms import standard_cifar_augment
 
             transform = standard_cifar_augment(
                 seed=ctx.process_index, dataset=args.dataset
             )
+            loader = DataLoader(
+                data, per_process_batch, sampler=sampler, transform=transform
+            )
         else:
-            transform = to_tensor  # reference parity (main.py:46: ToTensor only)
-        loader = DataLoader(
-            data, per_process_batch, sampler=sampler, transform=transform
-        )
+            # reference parity (main.py:46: ToTensor only)
+            loader = DataLoader(
+                data, per_process_batch, sampler=sampler, transform=to_tensor
+            )
 
     from tpudist.optim import make_optimizer
 
@@ -215,6 +245,7 @@ def main(argv=None):
         world_size=ctx.world_size,
         global_rank=ctx.process_index,
         grad_accum=args.grad_accum,
+        input_transform=input_transform,
         profile=not args.no_profiler,
         log_dir=args.log_dir,
         checkpoint_dir=args.checkpoint_dir,
