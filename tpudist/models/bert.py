@@ -85,9 +85,9 @@ class EncoderBlock(nn.Module):
             else:
                 attn_fn = None
                 if self.attn_impl == "ulysses_flash":
-                    from tpudist.ops.flash_attention import flash_attention
+                    from tpudist.ops.attention import kernel_attention
 
-                    attn_fn = flash_attention
+                    attn_fn = kernel_attention
                 attn = ulysses_attention(
                     q, k, v, self.mesh, causal=False, attn_fn=attn_fn
                 )

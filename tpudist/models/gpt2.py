@@ -95,12 +95,13 @@ class Block(nn.Module):
             else:
                 attn_fn = None
                 if self.attn_impl == "ulysses_flash":
-                    # full-sequence attention per head group via the Pallas
-                    # kernel — the long-context composition (all_to_all re-
-                    # shard + blockwise softmax)
-                    from tpudist.ops.flash_attention import flash_attention
+                    # full-sequence attention per head group via the best
+                    # Pallas kernel for the shape (vmem ≤1024 / blockwise
+                    # flash ≥2048) — the long-context composition
+                    # (all_to_all re-shard + fused-kernel softmax)
+                    from tpudist.ops.attention import kernel_attention
 
-                    attn_fn = flash_attention
+                    attn_fn = kernel_attention
                 attn = ulysses_attention(
                     q, k, v, self.mesh, causal=True, attn_fn=attn_fn
                 )

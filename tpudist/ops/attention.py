@@ -18,6 +18,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def kernel_attention(q, k, v, *, causal: bool = False):
+    """Best fused-kernel attention for the shape — the ``attn_fn`` to hand
+    composition sites (e.g. the Ulysses shard_map body, which sees the FULL
+    sequence with a local head group after its all-to-all): vmem kernel at
+    S ≤ 1024, blockwise flash at ≥ 2048, dense XLA between (the measured
+    v5e crossovers)."""
+    return multi_head_attention(q, k, v, causal=causal, impl="auto")
+
+
 def dot_product_attention(q, k, v, *, causal: bool = False, mask=None):
     """q,k,v: [B, S, H, D] (batch, seq, heads, head_dim) → [B, S, H, D]."""
     dtype = q.dtype
