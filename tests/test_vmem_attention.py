@@ -113,3 +113,49 @@ def test_multi_head_attention_kv_len_plumbed():
             q, k, v, impl="xla", kv_len=90,
             mask=jnp.ones((1, 1, 1, 128), bool),
         )
+
+
+def test_gpt2_model_vmem_matches_xla():
+    """Model-level: the bench's attn_impl='vmem' GPT-2 computes the same
+    function as the XLA oracle (same params, same tokens, same loss)."""
+    import optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    rng = np.random.Generator(np.random.PCG64(9))
+    tokens = rng.integers(0, 97, (8, 128)).astype(np.int32)
+    losses = {}
+    for impl in ("xla", "vmem"):
+        model = GPT2(vocab_size=97, max_seq_len=128, hidden_dim=32, depth=2,
+                     num_heads=4, attn_impl=impl)
+        tx = optax.adam(1e-3)
+        state = create_train_state(
+            model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens",
+        )
+        _, metrics = step(state, {"tokens": tokens})
+        losses[impl] = float(metrics["loss"])
+    assert abs(losses["vmem"] - losses["xla"]) < 2e-5, losses
+
+
+def test_vit_model_vmem_matches_xla():
+    """ViT at its ragged S (4-pixel patches on 32x32 → 65 tokens) through
+    the padded+masked kernel equals the XLA path."""
+    from tpudist.models import vit_b16
+
+    rng = np.random.Generator(np.random.PCG64(10))
+    images = jnp.asarray(rng.random((2, 32, 32, 3)), jnp.float32)
+    outs = {}
+    for impl in ("xla", "vmem"):
+        model = vit_b16(patch_size=4, depth=2, attn_impl=impl)
+        variables = model.init(jax.random.key(0), images[:1], train=False)
+        outs[impl] = np.asarray(
+            model.apply(variables, images, train=False)
+        )
+    np.testing.assert_allclose(outs["vmem"], outs["xla"], rtol=2e-4, atol=2e-4)
