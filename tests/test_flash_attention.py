@@ -56,10 +56,36 @@ def test_grads_match_xla(causal):
         np.testing.assert_allclose(gf, gr, atol=5e-4, rtol=5e-4)
 
 
-def test_indivisible_seq_raises():
-    q, k, v = _qkv(s=200)  # 200 % 128 != 0 → flash path refuses
-    with pytest.raises(NotImplementedError):
-        flash_attention(q, k, v, block_q=128, block_k=128)
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_seq_pads_and_masks(causal):
+    """200 % 128 != 0: the wrapper pads to 256 and masks the padded keys —
+    output and grads match the XLA oracle on the unpadded shape."""
+    q, k, v = _qkv(s=200)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) ** 2)
+
+    g_fl = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g_fl, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5, err_msg=name
+        )
+
+
+def test_explicit_kv_len_matches_sliced_keys():
+    q, k, v = _qkv(s=256)
+    ref = dot_product_attention(q, k[:, :130], v[:, :130], causal=False)
+    out = flash_attention(q, k, v, kv_len=130)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
 
 
 def test_head_dim_padding():
@@ -100,3 +126,40 @@ def test_pallas_bwd_matches_scan_bwd():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
+
+
+def test_pallas_bwd_kv_len_matches_scan_bwd():
+    """kv_len masking through the Pallas dq/dkv kernels (interpret mode)
+    agrees with the blockwise-scan backward on the same masked problem."""
+    from tpudist.ops.flash_attention import (
+        _bwd_blockwise, _bwd_pallas, _flash_fwd,
+    )
+
+    rng = np.random.Generator(np.random.PCG64(11))
+    B, S, H, D = 1, 256, 2, 128
+    sm = 1.0 / np.sqrt(D)
+    kv_len = 140  # second K block partially masked, none fully retired
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+        for _ in range(3)
+    )
+    o, lse = _flash_fwd(
+        q, k, v, causal=False, sm_scale=sm, block_q=128, block_k=128,
+        kv_len=kv_len,
+    )
+    g = jnp.asarray(rng.normal(size=o.shape), jnp.float32)
+    res = (q, k, v, o, lse)
+    got = _bwd_pallas(
+        res, g, causal=False, sm_scale=sm, block_q=128, block_k=128,
+        kv_len=kv_len, interpret=True,
+    )
+    want = _bwd_blockwise(
+        res, g, causal=False, sm_scale=sm, block_k=128, kv_len=kv_len
+    )
+    for name, a, b in zip("dq dk dv".split(), got, want):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5, err_msg=name
+        )
+    # padded keys receive zero gradient
+    assert np.abs(np.asarray(got[1][:, :, kv_len:])).max() == 0.0
+    assert np.abs(np.asarray(got[2][:, :, kv_len:])).max() == 0.0

@@ -159,3 +159,18 @@ def test_vit_model_vmem_matches_xla():
             model.apply(variables, images, train=False)
         )
     np.testing.assert_allclose(outs["vmem"], outs["xla"], rtol=2e-4, atol=2e-4)
+
+
+def test_multi_head_attention_kv_len_flash_impl():
+    """impl='flash' + kv_len stays on the kernel path (native in-kernel
+    masking, no dense fallback) and matches the sliced-K oracle."""
+    import warnings
+
+    q, k, v = _qkv(1, 256, 2, 64, seed=11)
+    ref = dot_product_attention(q, k[:, :130], v[:, :130], causal=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a fallback warning = test failure
+        out = multi_head_attention(q, k, v, impl="flash", kv_len=130)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )

@@ -91,9 +91,6 @@ def multi_head_attention(q, k, v, *, causal: bool = False, mask=None,
             impl = "xla"
         else:
             impl = "xla"  # auto + general mask → dense path
-    if kv_len is not None and kv_len < k.shape[1]:
-        # dense/flash paths: materialize the contiguous-padding key mask
-        mask = (jnp.arange(k.shape[1]) < kv_len)[None, None, None, :]
     if impl == "flash":
         if mask is not None:
             # no silent fallback: the caller picked flash to keep the S×S
@@ -103,15 +100,18 @@ def multi_head_attention(q, k, v, *, causal: bool = False, mask=None,
             warnings.warn(
                 "flash attention takes no general mask; falling back to XLA "
                 "attention (S×S scores in HBM) — for contiguous key padding "
-                "use the kernel's kv_len instead"
+                "use kv_len instead"
             )
         else:
             try:
                 from tpudist.ops.flash_attention import flash_attention
 
-                return flash_attention(q, k, v, causal=causal)
+                return flash_attention(q, k, v, causal=causal, kv_len=kv_len)
             except (ImportError, NotImplementedError) as e:
                 import warnings
 
                 warnings.warn(f"flash attention unavailable ({e}); using XLA attention")
+    if kv_len is not None and kv_len < k.shape[1]:
+        # dense path: materialize the contiguous-padding key mask
+        mask = (jnp.arange(k.shape[1]) < kv_len)[None, None, None, :]
     return dot_product_attention(q, k, v, causal=causal, mask=mask)

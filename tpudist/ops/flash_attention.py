@@ -51,6 +51,7 @@ def _fwd_kernel(
     o_ref, lse_ref,       # [1,1,bq,d], [1,1,bq,128] (lane-padded, see _flash_fwd)
     m_scr, l_scr, acc_scr,  # VMEM f32: [bq,128], [bq,128], [bq,d]
     *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int | None = None,
 ):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -62,11 +63,14 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal: K blocks strictly above the diagonal contribute nothing; skip
-    # them entirely (predicated off — no MXU work issued).
+    # Causal: K blocks strictly above the diagonal contribute nothing; a
+    # kv_len shorter than the padded K also retires whole blocks. Skip both
+    # entirely (predicated off — no MXU work issued).
     block_relevant = True
     if causal:
         block_relevant = ki * block_k <= qi * block_q + (block_q - 1)
+    if kv_len is not None:
+        block_relevant &= ki * block_k < kv_len
 
     @pl.when(block_relevant)
     def _compute():
@@ -77,14 +81,19 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         s = s * sm_scale  # [bq, bk]
-        if causal:
+        if causal or kv_len is not None:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = jnp.ones((block_q, block_k), bool)
+            if causal:
+                keep &= q_pos >= k_pos
+            if kv_len is not None:
+                keep &= k_pos < kv_len
+            s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                      # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
@@ -113,7 +122,7 @@ def _fwd_kernel(
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
+def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k, kv_len=None):
     """q,k,v: [B, H, S, D] → (o [B,H,S,D], lse [B,H,S] f32)."""
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
@@ -121,7 +130,8 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
     block_k = min(block_k, s_k)
     # TPU tile constraint: last-two dims of every VMEM block must align to
     # (8,128)/(16,128); requiring 128-multiples keeps the MXU fully fed.
-    # Non-conforming shapes fall back to XLA attention (ops/attention.py).
+    # Unaligned CALLER shapes are padded by flash_attention() (with kv_len
+    # masking the padded keys); reaching here misaligned is a bug.
     if s_q % block_q or s_k % block_k or block_q % 128 or block_k % 128:
         raise NotImplementedError(
             f"flash attention needs 128-aligned blocks: seq_q={s_q}, "
@@ -131,7 +141,7 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
     )
     # lse rides a lane-padded [b,h,s_q,128] buffer: a [*, *, bq] block would
     # put a size-1 dim in the sublane slot, which Mosaic's (8,128) tiling
@@ -166,6 +176,7 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k):
 def _recompute_p_ds(
     qi, ki, q, k, v, do, lse, delta,
     *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int | None = None,
 ):
     """Shared backward recompute: scores → (p, ds) for one (Q, K) tile.
 
@@ -176,14 +187,19 @@ def _recompute_p_ds(
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale  # [bq, bk]
-    if causal:
+    if causal or kv_len is not None:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        keep = jnp.ones((block_q, block_k), bool)
+        if causal:
+            keep &= q_pos >= k_pos
+        if kv_len is not None:
+            keep &= k_pos < kv_len
+        s = jnp.where(keep, s, NEG_INF)
     p = jnp.exp(s - lse)  # [bq, bk]
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -198,6 +214,7 @@ def _bwd_dkv_kernel(
     dk_ref, dv_ref,                      # [1,1,bk,d] ×2
     dk_scr, dv_scr,                      # VMEM f32 [bk,d]
     *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int | None = None,
 ):
     """dk/dv: K/V block resident, sweep over Q blocks (grid dim 3)."""
     ki = pl.program_id(2)
@@ -213,6 +230,9 @@ def _bwd_dkv_kernel(
     if causal:
         # K block contributes only to Q rows at or below the diagonal
         relevant = qi * block_q + (block_q - 1) >= ki * block_k
+    if kv_len is not None:
+        # fully-padded K blocks produce zero dk/dv (init covers them)
+        relevant &= ki * block_k < kv_len
 
     @pl.when(relevant)
     def _compute():
@@ -222,6 +242,7 @@ def _bwd_dkv_kernel(
             qi, ki, q, k_ref[0, 0], v_ref[0, 0], do,
             lse_ref[0, 0], delta_ref[0, 0],
             sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
+            kv_len=kv_len,
         )
         # dv += pᵀ·do ; dk += dsᵀ·q
         dv_scr[...] += jax.lax.dot_general(
@@ -244,6 +265,7 @@ def _bwd_dq_kernel(
     dq_ref,                              # [1,1,bq,d]
     dq_scr,                              # VMEM f32 [bq,d]
     *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int | None = None,
 ):
     """dq: Q block resident, sweep over K blocks (grid dim 3)."""
     qi = pl.program_id(2)
@@ -257,6 +279,8 @@ def _bwd_dq_kernel(
     relevant = True
     if causal:
         relevant = ki * block_k <= qi * block_q + (block_q - 1)
+    if kv_len is not None:
+        relevant &= ki * block_k < kv_len
 
     @pl.when(relevant)
     def _compute():
@@ -266,6 +290,7 @@ def _bwd_dq_kernel(
             do_ref[0, 0].astype(jnp.float32),
             lse_ref[0, 0], delta_ref[0, 0],
             sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
+            kv_len=kv_len,
         )
         dq_scr[...] += jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -277,7 +302,8 @@ def _bwd_dq_kernel(
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_pallas(res, g, *, causal, sm_scale, block_q, block_k, interpret=None):
+def _bwd_pallas(res, g, *, causal, sm_scale, block_q, block_k, kv_len=None,
+                interpret=None):
     """Pallas dq/dk/dv (FlashAttention-2 backward): two kernels, each
     recomputing p from the saved log-sum-exp — no S×S tensor in HBM."""
     q, k, v, o, lse = res
@@ -309,7 +335,7 @@ def _bwd_pallas(res, g, *, causal, sm_scale, block_q, block_k, interpret=None):
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
         ),
         grid=(b, h, nk, nq),
         in_specs=[qspec_j, qspec_j, rspec_j, rspec_j, kspec, kspec],
@@ -329,7 +355,7 @@ def _bwd_pallas(res, g, *, causal, sm_scale, block_q, block_k, interpret=None):
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
         ),
         grid=(b, h, nq, nk),
         in_specs=[kspec_j, kspec_j, qspec, qspec, rspec_i, rspec_i],
@@ -341,7 +367,7 @@ def _bwd_pallas(res, g, *, causal, sm_scale, block_q, block_k, interpret=None):
     return dq, dk, dv
 
 
-def _bwd_blockwise(res, g, *, causal, sm_scale, block_k):
+def _bwd_blockwise(res, g, *, causal, sm_scale, block_k, kv_len=None):
     """Blockwise backward from saved (q,k,v,o,lse): lax.scan over K blocks.
 
     Standard flash backward identities with the row log-sum-exp:
@@ -371,9 +397,14 @@ def _bwd_blockwise(res, g, *, causal, sm_scale, block_k):
     def one_block(dq_acc, inp):
         ki, kblk, vblk = inp
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk) * sm_scale
-        if causal:
+        if causal or kv_len is not None:
             k_pos = ki * block_k + jnp.arange(block_k)[None, :]
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = jnp.ones(s.shape[-2:], bool)
+            if causal:
+                keep &= q_pos >= k_pos
+            if kv_len is not None:
+                keep &= k_pos < kv_len
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse_e)                     # [b,h,sq,bk]
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, do)
         dp = jnp.einsum("bhqd,bhkd->bhqk", do, vblk)
@@ -389,30 +420,33 @@ def _bwd_blockwise(res, g, *, causal, sm_scale, block_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, pallas_bwd):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, pallas_bwd, kv_len):
     o, _ = _flash_fwd(
         q, k, v, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
     )
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, pallas_bwd):
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, pallas_bwd,
+                   kv_len):
     o, lse = _flash_fwd(
         q, k, v, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, pallas_bwd, res, g):
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, pallas_bwd, kv_len,
+                   res, g):
     if pallas_bwd and not _interpret():
         return _bwd_pallas(
             res, g, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
         )
-    return _bwd_blockwise(res, g, causal=causal, sm_scale=sm_scale, block_k=block_k)
+    return _bwd_blockwise(res, g, causal=causal, sm_scale=sm_scale,
+                          block_k=block_k, kv_len=kv_len)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -421,10 +455,14 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(
     q, k, v, *, causal: bool = False,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-    pallas_bwd: bool = False,
+    pallas_bwd: bool = False, kv_len: int | None = None,
 ):
     """Flash attention on [B, S, H, D] inputs (same layout as
     :func:`tpudist.ops.attention.dot_product_attention`).
+
+    Unaligned S is padded to the 128-tile multiple: padded KEYS are masked
+    inside the kernels (``kv_len`` — also passable explicitly for
+    right-padded batches), padded query rows are sliced off the output.
 
     ``pallas_bwd`` selects the Pallas FA-2 backward kernels instead of the
     default blockwise-scan backward. Both are O(S·block) memory; measured on
@@ -435,8 +473,24 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise NotImplementedError(f"expected [B,S,H,D], got {q.shape}")
-    d = q.shape[-1]
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if kv_len is None:
+        kv_len = s_k
+    if causal and s_q != s_k:
+        raise NotImplementedError("causal path assumes s_q == s_k")
     sm_scale = 1.0 / float(np.sqrt(d))
+    # Pad ragged sequences to the 128-tile multiple; the kernels mask the
+    # padded keys via kv_len and padded query rows are sliced off below.
+    pad_q = -s_q % 128
+    pad_k = -s_k % 128
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    # kv_len == padded length means "nothing masked": drop it so the
+    # kernels skip the mask compare entirely
+    eff_kv = None if kv_len == k.shape[1] else kv_len
     # Pad head_dim to the 128-lane tile. Zero-padded q/k leave scores
     # unchanged; padded v columns produce output columns sliced off below.
     d_pad = -d % 128
@@ -445,5 +499,6 @@ def flash_attention(
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
     # [B,S,H,D] → [B,H,S,D] for contiguous per-head tiles
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    o = _flash(qt, kt, vt, causal, sm_scale, block_q, block_k, pallas_bwd)
-    return o.transpose(0, 2, 1, 3)[..., :d]
+    o = _flash(qt, kt, vt, causal, sm_scale, block_q, block_k, pallas_bwd,
+               eff_kv)
+    return o.transpose(0, 2, 1, 3)[:, :s_q, :, :d]
