@@ -87,3 +87,35 @@ def test_epoch_reshuffle_changes_order():
     idx1 = next(iter(loader))["image"]
     assert sorted(idx0) == sorted(idx1) == list(range(32))
     assert not np.array_equal(idx0, idx1)
+
+
+def test_evaluate_through_cached_loader():
+    """The eval pass composes with the cache the same way training does:
+    index batches + input_transform — same accuracy as the host loader."""
+    from tpudist.train import evaluate
+
+    data = _dataset(n=48, seed=5)
+    mesh = mesh_lib.create_mesh()
+    model = resnet18(num_classes=10, small_inputs=True)
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 16, 16, 3)), optax.adam(1e-3), mesh
+    )
+    norm = device_normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+
+    host_loader = DataLoader(
+        data, 16,
+        sampler=DistributedSampler(48, 1, 0, shuffle=False),
+        transform=None, drop_remainder=False,
+    )
+    acc_host = evaluate(model, state, host_loader, mesh, input_transform=norm)
+
+    cached = DeviceCachedLoader(
+        data, 16, mesh=mesh,
+        sampler=DistributedSampler(48, 1, 0, shuffle=False),
+        drop_remainder=False,
+    )
+    acc_cached = evaluate(
+        model, state, cached, mesh,
+        input_transform=cached.input_transform(norm),
+    )
+    assert acc_host == acc_cached

