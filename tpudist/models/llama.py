@@ -116,9 +116,12 @@ class LlamaBlock(nn.Module):
         else:
             q = apply_rope(q, theta=self.rope_theta)
             k = apply_rope(k, theta=self.rope_theta)
-            if kv != h:
-                # GQA: broadcast each K/V head over its query group; XLA
-                # fuses the repeat into the attention matmuls
+            if kv != h and self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
+                # the context-parallel bodies shard/rotate full head sets;
+                # broadcast K/V heads up front there. The multi_head_attention
+                # dispatch below takes grouped K/V as-is — the vmem kernel
+                # reads each K/V head once per query group (no repeat in
+                # HBM), and its dense/flash fallbacks repeat internally.
                 k = jnp.repeat(k, h // kv, axis=2)
                 v = jnp.repeat(v, h // kv, axis=2)
             if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
@@ -135,9 +138,9 @@ class LlamaBlock(nn.Module):
                 else:
                     attn_fn = None
                     if self.attn_impl == "ulysses_flash":
-                        from tpudist.ops.flash_attention import flash_attention
+                        from tpudist.ops.attention import kernel_attention
 
-                        attn_fn = flash_attention
+                        attn_fn = kernel_attention
                     attn = ulysses_attention(
                         q, k, v, self.mesh, causal=True, attn_fn=attn_fn
                     )

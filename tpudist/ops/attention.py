@@ -97,6 +97,13 @@ def multi_head_attention(q, k, v, *, causal: bool = False, mask=None,
             impl = "xla"
         else:
             impl = "xla"  # auto + general mask → dense path
+    if k.shape[2] != q.shape[2]:
+        # GQA reaching the dense/flash paths (vmem handles grouped K/V
+        # natively): broadcast each K/V head over its query group — XLA
+        # fuses the repeat into the attention matmuls
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if impl == "flash":
         if mask is not None:
             # no silent fallback: the caller picked flash to keep the S×S

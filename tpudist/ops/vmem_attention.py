@@ -93,11 +93,13 @@ def _loop_heads(group: int, body):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, sm_scale, causal, kv_len, group):
+                *, sm_scale, causal, kv_len, group, kv_shared):
     def one(i):
         q = q_ref[0, i]  # [Sq, D]
-        k = k_ref[0, i]  # [Sk, D]
-        v = v_ref[0, i]
+        # GQA (kv_shared): the whole q-head block reads ONE resident K/V
+        # head — grouped K/V never get repeated in HBM
+        k = k_ref[0, 0] if kv_shared else k_ref[0, i]  # [Sk, D]
+        v = v_ref[0, 0] if kv_shared else v_ref[0, i]
         s = _masked_scores(q, k, sm_scale, causal=causal, kv_len=kv_len)
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
@@ -114,11 +116,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dq_ref, dk_ref, dv_ref,
-                *, sm_scale, causal, kv_len, group):
+                *, sm_scale, causal, kv_len, group, kv_shared, ratio):
+    if kv_shared:
+        # GQA: the ratio consecutive grid steps mapping to one K/V head
+        # revisit the SAME dk/dv output block (Pallas keeps a revisited
+        # block resident between consecutive steps); zero it on the first
+        # visiting step, accumulate on the rest
+        hg = pl.program_id(1)
+        first_visit = (hg * group) % ratio == 0
+
+        @pl.when(first_visit)
+        def _init():
+            dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+            dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
     def one(i):
         q = q_ref[0, i]
-        k = k_ref[0, i]
-        v = v_ref[0, i]
+        k = k_ref[0, 0] if kv_shared else k_ref[0, i]
+        v = v_ref[0, 0] if kv_shared else v_ref[0, i]
         o = o_ref[0, i].astype(jnp.float32)
         do = do_ref[0, i].astype(jnp.float32)
         lse = lse_ref[0, i]  # [Sq, 1] f32
@@ -126,10 +141,10 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         p = jnp.exp(s - lse)  # [Sq, Sk] f32; exact probs (no rescale needed)
         pb = p.astype(v.dtype)
         dob = do.astype(v.dtype)
-        dv_ref[0, i] = jax.lax.dot_general(
+        dv = jax.lax.dot_general(
             pb, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ).astype(dv_ref.dtype)
+        )
         dp = jax.lax.dot_general(
             dob, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -138,9 +153,16 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0, i] = jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ).astype(dq_ref.dtype)
-        dk_ref[0, i] = jax.lax.dot_general(
+        dk = jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        ).astype(dk_ref.dtype)
+        )
+        if kv_shared:
+            # every q-head in the block feeds the one K/V head's grads
+            dv_ref[0, 0] += dv.astype(dv_ref.dtype)
+            dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+        else:
+            dv_ref[0, i] = dv.astype(dv_ref.dtype)
+            dk_ref[0, i] = dk.astype(dk_ref.dtype)
 
     _loop_heads(group, one)
 
@@ -165,17 +187,43 @@ def _spec(g, s, d):
     return pl.BlockSpec((1, g, s, d), lambda b, hg: (b, hg, 0, 0))
 
 
+def _geometry(q, k):
+    """(group, ratio, kv_shared, kv_spec) for the grid. MHA: K/V blocks
+    mirror the q-head grouping. GQA (fewer K/V heads): each grid step's
+    q-head block reads its ONE K/V head — the group is clamped to divide
+    the q-per-kv ratio so a block never spans two K/V heads, and the K/V
+    BlockSpec maps grid step hg to kv head (hg·g)/ratio."""
+    import math
+
+    b, h, s_q, d = q.shape
+    h_kv, s_k = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise NotImplementedError(
+            f"q heads {h} not a multiple of kv heads {h_kv}"
+        )
+    ratio = h // h_kv
+    g = _head_group(h, max(s_q, s_k))
+    if ratio > 1:
+        g = math.gcd(g, ratio)
+        kv_spec = pl.BlockSpec(
+            (1, 1, s_k, d),
+            lambda b, hg, _g=g, _r=ratio: (b, (hg * _g) // _r, 0, 0),
+        )
+        return g, ratio, True, kv_spec
+    return g, 1, False, _spec(g, s_k, d)
+
+
 def _vmem_fwd_raw(q, k, v, *, causal, sm_scale, kv_len):
     b, h, s_q, d = q.shape
-    s_k = k.shape[2]
-    g = _head_group(h, max(s_q, s_k))
+    g, ratio, kv_shared, kv_spec = _geometry(q, k)
     kern = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len, group=g
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
+        group=g, kv_shared=kv_shared,
     )
     return pl.pallas_call(
         kern,
         grid=(b, h // g),
-        in_specs=[_spec(g, s_q, d), _spec(g, s_k, d), _spec(g, s_k, d)],
+        in_specs=[_spec(g, s_q, d), kv_spec, kv_spec],
         out_specs=[_spec(g, s_q, d), _spec(g, s_q, 1)],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -199,26 +247,28 @@ def _vmem_vjp_fwd(q, k, v, causal, sm_scale, kv_len):
 def _vmem_vjp_bwd(causal, sm_scale, kv_len, res, g):
     q, k, v, o, lse = res
     b, h, s_q, d = q.shape
-    s_k = k.shape[2]
-    grp = _head_group(h, max(s_q, s_k))
+    grp, ratio, kv_shared, kv_spec = _geometry(q, k)
     kern = functools.partial(
         _bwd_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
-        group=grp,
+        group=grp, kv_shared=kv_shared, ratio=ratio,
     )
+    # GQA: dk/dv accumulate ratio/grp revisits (plus grp in-block q-heads)
+    # into the same output block — accumulate in f32, cast after
+    kv_grad_dtype = jnp.float32 if kv_shared else k.dtype
     dq, dk, dv = pl.pallas_call(
         kern,
         grid=(b, h // grp),
-        in_specs=[_spec(grp, s_q, d), _spec(grp, s_k, d), _spec(grp, s_k, d),
+        in_specs=[_spec(grp, s_q, d), kv_spec, kv_spec,
                   _spec(grp, s_q, d), _spec(grp, s_q, d), _spec(grp, s_q, 1)],
-        out_specs=[_spec(grp, s_q, d), _spec(grp, s_k, d), _spec(grp, s_k, d)],
+        out_specs=[_spec(grp, s_q, d), kv_spec, kv_spec],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, kv_grad_dtype),
+            jax.ShapeDtypeStruct(v.shape, kv_grad_dtype),
         ],
         interpret=_interpret(),
     )(q, k, v, o, g, lse)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _vmem.defvjp(_vmem_vjp_fwd, _vmem_vjp_bwd)
@@ -233,6 +283,11 @@ def vmem_attention(q, k, v, *, causal: bool = False, kv_len: int | None = None):
     output. ``kv_len`` may also be passed explicitly for right-padded
     batches whose true key length is shorter than S (every sequence in the
     batch shares it — a static int, not a per-row tensor).
+
+    GQA: ``k``/``v`` may carry fewer heads than ``q`` (heads divisible).
+    The kernel reads each K/V head once per query group straight from the
+    grouped layout — no ``jnp.repeat`` materializes in HBM — and the
+    backward accumulates the group's dk/dv in f32.
 
     Raises NotImplementedError for S_pad > MAX_SEQ (VMEM budget) — callers
     (``multi_head_attention(impl="auto")``) route long sequences to the
