@@ -265,8 +265,9 @@ def bench_gpt2() -> None:
 
     # vmem attention: whole-sequence-in-VMEM Pallas kernel — measured 126k
     # vs 80k tok/s/chip with XLA attention on this step (interleaved A/B,
-    # v5e; tpudist/ops/vmem_attention.py)
-    model = GPT2(dtype=jnp.bfloat16, attn_impl="vmem")  # 124M defaults
+    # v5e; tpudist/ops/vmem_attention.py). mesh= engages the shard_map wrap
+    # on multi-chip meshes (no-op on one chip).
+    model = GPT2(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
     tx = optax.adam(1e-3)
     state = create_train_state(
         model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
@@ -361,7 +362,7 @@ def bench_vit() -> None:
 
     # vmem attention handles S=197 by padding to 256 + in-kernel key mask
     # (head-grouped grid); measured 774 vs 747 img/s over XLA attention
-    model = vit_b16(dtype=jnp.bfloat16, attn_impl="vmem")
+    model = vit_b16(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
     tx = optax.adam(1e-3)
     state = create_train_state(model, 0, jnp.zeros((1, 224, 224, 3)), tx, mesh)
     step = make_train_step(model, tx, mesh)
@@ -407,7 +408,8 @@ def bench_gpt2_long_context() -> None:
 
     def rate(attn_impl, n_steps=12):
         model = GPT2(
-            dtype=jnp.bfloat16, max_seq_len=seq_len, attn_impl=attn_impl
+            dtype=jnp.bfloat16, max_seq_len=seq_len, attn_impl=attn_impl,
+            mesh=mesh,
         )
         tx = optax.adam(1e-3)
         state = create_train_state(

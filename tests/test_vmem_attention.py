@@ -231,3 +231,34 @@ def test_gqa_through_dispatcher_and_fallback():
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
             err_msg=impl,
         )
+
+
+def test_mesh_shard_map_wrap_matches_unwrapped():
+    """multi_head_attention(mesh=...) runs the kernel per-shard inside
+    shard_map (the multi-chip Pallas path: pallas_call has no GSPMD rule);
+    the wrap must be loss-exact vs the unwrapped single-program path."""
+    import optax
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    rng = np.random.Generator(np.random.PCG64(40))
+    tokens = rng.integers(0, 97, (8, 128)).astype(np.int32)
+    losses = {}
+    for wrapped in (False, True):
+        model = GPT2(vocab_size=97, max_seq_len=128, hidden_dim=32, depth=2,
+                     num_heads=4, attn_impl="vmem",
+                     mesh=mesh if wrapped else None)
+        tx = optax.adam(1e-3)
+        state = create_train_state(
+            model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens",
+        )
+        _, metrics = step(state, {"tokens": tokens})
+        losses[wrapped] = float(metrics["loss"])
+    assert abs(losses[True] - losses[False]) < 2e-5, losses

@@ -101,7 +101,8 @@ class EncoderBlock(nn.Module):
                 else attention_mask[:, None, None, :].astype(bool)
             )
             attn = multi_head_attention(
-                q, k, v, causal=False, mask=key_mask, impl=self.attn_impl
+                q, k, v, causal=False, mask=key_mask, impl=self.attn_impl,
+                mesh=self.mesh,
             )
         y = nn.DenseGeneral(
             d, axis=(-2, -1), dtype=self.dtype, name="out",

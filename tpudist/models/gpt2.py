@@ -106,7 +106,11 @@ class Block(nn.Module):
                     q, k, v, self.mesh, causal=True, attn_fn=attn_fn
                 )
         else:
-            attn = multi_head_attention(q, k, v, causal=True, impl=self.attn_impl)
+            attn = multi_head_attention(
+                q, k, v, causal=True, impl=self.attn_impl,
+                # multi-chip Pallas runs need the per-shard shard_map wrap
+                mesh=self.mesh,
+            )
         # row-parallel: contraction dim sharded; GSPMD all-reduces the output
         y = nn.DenseGeneral(
             d, axis=(-2, -1), dtype=self.dtype, name="out",

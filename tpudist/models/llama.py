@@ -110,8 +110,9 @@ class LlamaBlock(nn.Module):
             q = apply_rope(q, theta=self.rope_theta,
                            positions=(pos + jnp.arange(s)).astype(jnp.float32))
             if kv != h:
-                keys = jnp.repeat(keys, h // kv, axis=2)
-                values = jnp.repeat(values, h // kv, axis=2)
+                from tpudist.ops.attention import repeat_kv
+
+                keys, values = repeat_kv(q, keys, values)
             attn = dot_product_attention(q, keys, values, mask=mask)
         else:
             q = apply_rope(q, theta=self.rope_theta)
@@ -122,8 +123,9 @@ class LlamaBlock(nn.Module):
                 # dispatch below takes grouped K/V as-is — the vmem kernel
                 # reads each K/V head once per query group (no repeat in
                 # HBM), and its dense/flash fallbacks repeat internally.
-                k = jnp.repeat(k, h // kv, axis=2)
-                v = jnp.repeat(v, h // kv, axis=2)
+                from tpudist.ops.attention import repeat_kv
+
+                k, v = repeat_kv(q, k, v)
             if self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
                 if self.mesh is None:
                     raise ValueError(
@@ -146,7 +148,8 @@ class LlamaBlock(nn.Module):
                     )
             else:
                 attn = multi_head_attention(
-                    q, k, v, causal=True, impl=self.attn_impl
+                    q, k, v, causal=True, impl=self.attn_impl,
+                    mesh=self.mesh,
                 )
         # row-parallel output projection; GSPMD all-reduces over 'tensor'
         x = x + nn.DenseGeneral(

@@ -47,6 +47,7 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
     dropout: float = 0.0
+    mesh: Any = None  # multi-chip Pallas attention (shard_map wrap)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -64,7 +65,8 @@ class EncoderBlock(nn.Module):
             bias_init=_partitioned(nn.initializers.zeros_init(), None, TENSOR_AXIS, None),
         )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = multi_head_attention(q, k, v, impl=self.attn_impl)
+        attn = multi_head_attention(q, k, v, impl=self.attn_impl,
+                                    mesh=self.mesh)
         y = nn.DenseGeneral(
             d, axis=(-2, -1), dtype=self.dtype, name="out",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
@@ -84,6 +86,7 @@ class ViT(nn.Module):
     dtype: Any = jnp.float32
     attn_impl: str = "xla"
     dropout: float = 0.0  # residual dropout; rng plumbed by tpudist.train
+    mesh: Any = None  # multi-chip Pallas attention (shard_map wrap)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -105,7 +108,7 @@ class ViT(nn.Module):
             x = EncoderBlock(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, dropout=self.dropout,
-                name=f"block_{i}",
+                mesh=self.mesh, name=f"block_{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])

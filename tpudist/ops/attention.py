@@ -24,6 +24,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def repeat_kv(q, k, v):
+    """Broadcast grouped K/V heads over their query groups ([.., H_kv, D] →
+    [.., H, D]) — the GQA normalization for attention paths that need equal
+    head counts. XLA fuses the repeat into the attention matmuls. One home
+    for the ratio math: callers must not hand-roll the repeat."""
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def kernel_attention(q, k, v, *, causal: bool = False):
     """Best fused-kernel attention for the shape — the ``attn_fn`` to hand
     composition sites (e.g. the Ulysses shard_map body, which sees the FULL
@@ -52,7 +66,8 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None):
 
 
 def multi_head_attention(q, k, v, *, causal: bool = False, mask=None,
-                         impl: str = "xla", kv_len: int | None = None):
+                         impl: str = "xla", kv_len: int | None = None,
+                         mesh=None):
     """Dispatch over the three attention paths:
 
     - ``xla``: dense einsum attention (oracle; takes arbitrary masks);
@@ -67,9 +82,63 @@ def multi_head_attention(q, k, v, *, causal: bool = False, mask=None,
     ``kv_len``: static true key length for contiguous right-padded K/V —
     the kernels mask padded keys in-kernel; the dense path builds the
     equivalent iota mask. Mutually exclusive with ``mask``.
+
+    ``mesh``: pass the model's mesh on MULTI-CHIP data-parallel runs that
+    want a Pallas kernel. ``pallas_call`` has no GSPMD partitioning rule,
+    so on a >1-device data axis the kernel must run per-shard inside
+    ``shard_map`` (attention is batch-parallel — the wrap is exact); with
+    ``mesh=None`` the kernels still partition correctly under pure
+    single-chip-per-process DP (one shard per program) and on the CPU
+    interpret path (decomposed into partitionable jax ops).
     """
     if mask is not None and kv_len is not None:
         raise ValueError("pass mask or kv_len, not both")
+    if mesh is not None and impl in ("vmem", "flash", "auto") and mask is None:
+        from tpudist import mesh as mesh_lib
+
+        dp = int(np.prod([
+            mesh.shape[a] for a in (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+        ]))
+        tp = mesh.shape[mesh_lib.TENSOR_AXIS]
+        # indivisible shapes (e.g. the batch-1 init trace) fall through to
+        # the unwrapped path — negligible work there, and shard_map would
+        # refuse; a REAL training shape falling through on a multi-device
+        # mesh is a misconfiguration worth a loud warning
+        divisible = (
+            q.shape[0] % dp == 0
+            and q.shape[2] % tp == 0
+            and k.shape[2] % tp == 0
+        )
+        multi = dp > 1 or tp > 1
+        if multi and not divisible and q.shape[0] > 1:
+            import warnings
+
+            warnings.warn(
+                f"pallas attention on a {dp}x dp / {tp}x tp mesh with "
+                f"shapes (batch {q.shape[0]}, q heads {q.shape[2]}, kv "
+                f"heads {k.shape[2]}) not divisible by the mesh axes: "
+                "running UNWRAPPED (GSPMD cannot partition pallas_call — "
+                "expect gathers/replication); adjust batch/head counts"
+            )
+        if multi and divisible:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            # batch over data/fsdp, heads over tensor (Megatron TP keeps
+            # qkv head-sharded) — attention is parallel over both, so the
+            # per-shard kernel is exact with no collective
+            spec = P((mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS), None,
+                     mesh_lib.TENSOR_AXIS, None)
+            fn = shard_map(
+                lambda q, k, v: multi_head_attention(
+                    q, k, v, causal=causal, impl=impl, kv_len=kv_len
+                ),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                # pallas_call can't declare varying-manual-axes on its
+                # out_shape (same caveat as parallel/cp.py)
+                check_vma=False,
+            )
+            return fn(q, k, v)
     if impl in ("vmem", "auto"):
         if mask is None:
             try:
@@ -99,11 +168,8 @@ def multi_head_attention(q, k, v, *, causal: bool = False, mask=None,
             impl = "xla"  # auto + general mask → dense path
     if k.shape[2] != q.shape[2]:
         # GQA reaching the dense/flash paths (vmem handles grouped K/V
-        # natively): broadcast each K/V head over its query group — XLA
-        # fuses the repeat into the attention matmuls
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        # natively)
+        k, v = repeat_kv(q, k, v)
     if impl == "flash":
         if mask is not None:
             # no silent fallback: the caller picked flash to keep the S×S
