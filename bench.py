@@ -471,7 +471,31 @@ def _run_with_retry(fn) -> None:
         fn()
 
 
+def _attach_alive(timeout_s: float = 240.0) -> bool:
+    """Probe the accelerator attach in a SUBPROCESS with a timeout: a
+    wedged remote attach hangs jax.devices() indefinitely (observed on a
+    tunnel attach after a host migration), and a hung bench records
+    nothing — failing fast with a clear message is strictly better."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return out.returncode == 0 and int(out.stdout.split()[-1]) >= 1
+    except Exception:
+        return False
+
+
 def main() -> None:
+    if not _attach_alive():
+        raise SystemExit(
+            "bench: no responsive accelerator attach (device probe hung or "
+            "failed) — not a framework failure; re-run when the attach is "
+            "healthy"
+        )
     _run_with_retry(bench_resnet)
     _run_with_retry(bench_vit)
     _run_with_retry(bench_gpt2)
