@@ -489,17 +489,100 @@ def _attach_alive(timeout_s: float = 240.0) -> bool:
         return False
 
 
+# leg groups: (function, wall-clock budget in seconds). Budgets are ~3x the
+# healthy-attach duration of each group, so they only fire on a wedge.
+_LEG_GROUPS = {
+    "resnet": (bench_resnet, 2100),
+    "vit": (bench_vit, 1500),
+    "gpt2": (bench_gpt2, 2400),
+    "long_context": (bench_gpt2_long_context, 1800),
+}
+
+
+def _run_leg_subprocess(name: str, budget_s: float) -> bool:
+    """Run one leg group in a child process with a wall-clock budget.
+
+    The remote attach has been observed to wedge MID-RUN (an in-flight
+    device call blocks forever — docs/PERF.md §3 documents the link
+    collapsing after compiled programs; this session saw a full stall).
+    In-process, one wedged leg would starve every later leg and the round
+    would record a partial benchmark. Each group in its own process gets
+    (a) a fresh attach, (b) a kill switch, and (c) isolation: the GPT-2
+    legs still run even if a vision leg hangs. Children inherit stdout, so
+    the JSON-line contract is unchanged."""
+    import subprocess
+    import sys
+
+    import os
+    import signal
+
+    # new session: the budget kill must take out the child's own subtree
+    # too (its _attach_alive probe spawns a grandchild that can be the very
+    # process hung on the wedged attach — orphaning it would hold the
+    # attach and defeat the isolation)
+    proc = subprocess.Popen(
+        [sys.executable, __file__, "--leg", name], start_new_session=True
+    )
+    try:
+        rc = proc.wait(timeout=budget_s)
+        if rc != 0:
+            print(f"bench: leg group '{name}' exited rc={rc}; continuing",
+                  file=sys.stderr, flush=True)
+        return rc == 0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        print(
+            f"bench: leg group '{name}' exceeded its {budget_s:.0f}s budget "
+            "(attach wedge) — killed; continuing with the remaining legs",
+            file=sys.stderr, flush=True,
+        )
+        return False
+
+
 def main() -> None:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--leg", default=None, choices=sorted(_LEG_GROUPS),
+                    help="run ONE leg group in this process (child mode)")
+    args = ap.parse_args()
+
+    if args.leg is not None:
+        fn, _ = _LEG_GROUPS[args.leg]
+        if not _attach_alive():
+            print(f"bench: leg group '{args.leg}' skipped — device probe "
+                  "hung or failed (attach wedge, not a framework failure)",
+                  file=sys.stderr, flush=True)
+            raise SystemExit(3)
+        _run_with_retry(fn)
+        return
+
     if not _attach_alive():
         raise SystemExit(
             "bench: no responsive accelerator attach (device probe hung or "
             "failed) — not a framework failure; re-run when the attach is "
             "healthy"
         )
-    _run_with_retry(bench_resnet)
-    _run_with_retry(bench_vit)
-    _run_with_retry(bench_gpt2)
-    _run_with_retry(bench_gpt2_long_context)
+    ok = {
+        name: _run_leg_subprocess(name, budget_s)
+        for name, (_, budget_s) in _LEG_GROUPS.items()
+    }
+    if not all(ok.values()):
+        failed = [n for n, good in ok.items() if not good]
+        print(f"bench: leg groups failed: {failed} — metrics above are "
+              "partial", file=sys.stderr, flush=True)
+        # exit 5 = no leg group COMPLETED (stdout may still carry metric
+        # lines a group emitted before failing), 4 = some completed;
+        # 2 stays argparse's usage error
+        raise SystemExit(5 if not any(ok.values()) else 4)
 
 
 if __name__ == "__main__":

@@ -119,3 +119,69 @@ def test_evaluate_through_cached_loader():
         input_transform=cached.input_transform(norm),
     )
     assert acc_host == acc_cached
+
+
+def test_grad_accum_with_cached_loader():
+    """grad_accum scans microbatches; the "_cache" operand has no
+    microbatch dim and must ride into each microbatch unscanned. The
+    accumulated run must match the host loader's accumulated run."""
+    data = _dataset(n=64, seed=7)
+    mesh = mesh_lib.create_mesh()
+    model = resnet18(num_classes=10, small_inputs=True)
+    norm = device_normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+
+    def run(cached: bool):
+        tx = optax.adam(1e-3)
+        state = create_train_state(
+            model, 0, jnp.zeros((1, 16, 16, 3)), tx, mesh
+        )
+        if cached:
+            loader = DeviceCachedLoader(data, 32, mesh=mesh, seed=4)
+            tf = loader.input_transform(norm)
+        else:
+            loader = DataLoader(
+                data, 32,
+                sampler=DistributedSampler(64, 1, 0, seed=4),
+                transform=None,
+            )
+            tf = norm
+        step = make_train_step(
+            model, tx, mesh, grad_accum=2, input_transform=tf
+        )
+        losses = []
+        loader.sampler.set_epoch(0)
+        for batch in loader:
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    host = run(cached=False)
+    cached = run(cached=True)
+    assert len(host) == len(cached) == 2
+    np.testing.assert_allclose(cached, host, rtol=1e-6)
+
+
+def test_cache_is_not_lowered_as_hlo_literal():
+    """The whole point of the batch-carried cache: the dataset must reach
+    the compiled program as an ARGUMENT. A closure-captured cache lowers as
+    an HLO literal — hundreds of MB shipped with the HLO on every remote
+    compile (measured as a multi-minute wedge on the axon attach)."""
+    import jax
+
+    data = _dataset(n=256, seed=9)  # 196KB cache: literal would be visible
+    mesh = mesh_lib.create_mesh()
+    loader = DeviceCachedLoader(data, 8, mesh=mesh)
+    tf = loader.input_transform()
+    batch = next(iter(loader))
+
+    def f(batch):
+        return tf(batch["image"], batch).astype(jnp.float32).sum()
+
+    staged = {
+        k: v if isinstance(v, jax.Array) else jnp.asarray(v)
+        for k, v in batch.items()
+    }
+    txt = jax.jit(f).lower(staged).as_text()
+    assert len(txt) < 100_000, (
+        f"HLO text is {len(txt)} bytes — the cache leaked in as a literal"
+    )

@@ -124,13 +124,21 @@ class DeviceCachedLoader:
         """The in-graph ``indices → images`` gather to pass as
         ``make_train_step(input_transform=...)``; ``post`` (e.g.
         :func:`tpudist.data.transforms.device_normalize`) is applied to the
-        gathered batch inside the same program."""
-        cache = self._cache
+        gathered batch inside the same program.
 
-        def run(indices):
-            batch = jnp.take(cache, indices, axis=0)
-            return post(batch) if post is not None else batch
+        The cache array reaches the compiled program as a REAL argument —
+        every batch this loader yields carries it under ``"_cache"`` and the
+        transform declares ``wants_batch`` (the make_train_step/evaluate
+        contract). Capturing it in the closure instead would lower the
+        whole dataset as an HLO literal: measured as a multi-minute compile
+        stall on a remote-compile attach (the literal ships with the HLO
+        over the degraded tunnel) and a duplicated copy in device memory."""
 
+        def run(indices, batch):
+            gathered = jnp.take(batch["_cache"], indices, axis=0)
+            return post(gathered) if post is not None else gathered
+
+        run.wants_batch = True
         return run
 
     def _index_batches(self):
@@ -150,6 +158,10 @@ class DeviceCachedLoader:
         return {
             self.input_key: np.ascontiguousarray(idx.astype(np.int32)),
             self.label_key: np.ascontiguousarray(self._labels[idx]),
+            # the HBM cache rides along as a device array (stage() and
+            # _padded_batches pass jax.Arrays through) so the in-graph
+            # gather sees it as a jit argument, not a baked-in literal
+            "_cache": self._cache,
         }
 
     def __iter__(self):

@@ -183,10 +183,25 @@ def put_sharded(x: np.ndarray, sharding: NamedSharding) -> jax.Array:
 
 def shard_batch(batch, mesh: Mesh):
     """Place a host-local batch (numpy pytree) onto the mesh, sharded over
-    the batch dimension."""
-    return jax.tree_util.tree_map(
+    the batch dimension.
+
+    Dict keys starting with ``"_"`` are per-step device-resident operands
+    (the DeviceCachedLoader's ``"_cache"`` contract — see
+    ``tpudist.train._apply_input_transform``), not row data: they pass
+    through untouched. Without the exemption, ``np.asarray`` would fetch
+    the whole HBM cache to host and re-upload it batch-sharded on every
+    batch."""
+    if isinstance(batch, dict):
+        passthrough = {k: v for k, v in batch.items() if k.startswith("_")}
+        rows = {k: v for k, v in batch.items() if k not in passthrough}
+    else:
+        passthrough, rows = {}, batch
+    out = jax.tree_util.tree_map(
         lambda x: put_sharded(
             np.asarray(x), batch_sharding(mesh, extra_dims=np.ndim(x) - 1)
         ),
-        batch,
+        rows,
     )
+    if passthrough:
+        out = {**out, **passthrough}
+    return out

@@ -135,6 +135,23 @@ def state_shardings_of(state: TrainState):
     return jax.tree_util.tree_map(lambda x: x.sharding, state)
 
 
+
+def _apply_input_transform(transform, inputs, batch):
+    """The one home for the input_transform calling convention: plain
+    transforms receive the inputs; transforms declaring ``wants_batch``
+    also receive the whole batch dict — the hook for device-resident
+    operands (e.g. DeviceCachedLoader's "_cache") that must arrive as REAL
+    jit arguments. A closure-captured jax.Array would be lowered as an HLO
+    literal, and on a remote-compile attach a literal the size of a dataset
+    ships with the HLO over the (slow) tunnel — a measured multi-minute
+    stall per compile."""
+    if transform is None:
+        return inputs
+    if getattr(transform, "wants_batch", False):
+        return transform(inputs, batch)
+    return transform(inputs)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -203,9 +220,7 @@ def make_train_step(
     def forward(params, batch_stats, batch, step):
         variables = {"params": params, "batch_stats": batch_stats}
         has_stats = len(batch_stats) > 0
-        inputs = batch[input_key]
-        if input_transform is not None:
-            inputs = input_transform(inputs)
+        inputs = _apply_input_transform(input_transform, batch[input_key], batch)
         mutable = (["batch_stats"] if has_stats else []) + (
             ["losses"] if wants_aux else []
         )
@@ -245,12 +260,21 @@ def make_train_step(
                 state.params, state.batch_stats, batch, state.step
             )
         else:
+            # "_"-prefixed keys are per-step operands (e.g. the
+            # DeviceCachedLoader's "_cache"), not row data: they have no
+            # microbatch dim, so they ride into every microbatch unscanned
+            # instead of being scanned over (whose leading-axis check they
+            # would fail)
+            operands = {k: v for k, v in batch.items() if k.startswith("_")}
+            rows = {k: v for k, v in batch.items() if not k.startswith("_")}
+
             def micro(carry, xs):
                 mb, i = xs
                 gsum, stats, lsum = carry
                 # distinct dropout stream per microbatch
                 (l, stats), g = grad_fn(
-                    state.params, stats, mb, state.step * grad_accum + i
+                    state.params, stats, {**mb, **operands},
+                    state.step * grad_accum + i
                 )
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
                 return (gsum, stats, lsum + l), None
@@ -261,7 +285,7 @@ def make_train_step(
             (gsum, new_stats, lsum), _ = jax.lax.scan(
                 micro,
                 (zeros, state.batch_stats, jnp.zeros((), jnp.float32)),
-                (batch, jnp.arange(grad_accum)),
+                (rows, jnp.arange(grad_accum)),
             )
             grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
             loss = lsum / grad_accum
@@ -575,7 +599,20 @@ def _padded_batches(loader, mesh: Mesh, key: str):
     dp = mesh_lib.data_parallel_size(mesh)
     target = None
     for batch in loader:
-        batch = {k: np.asarray(v) for k, v in batch.items()}
+        # "_"-prefixed keys are per-step operands (e.g. the
+        # DeviceCachedLoader's "_cache"), not row data: pass them through
+        # to the compiled program untouched instead of fetching them to
+        # host and "padding" them. Only the reserved prefix is exempt — a
+        # foreign loader yielding jax.Arrays for ordinary row data keeps
+        # the old np.asarray path.
+        passthrough = {
+            k: v for k, v in batch.items() if k.startswith("_")
+        }
+        batch = {
+            k: np.asarray(v)
+            for k, v in batch.items()
+            if k not in passthrough
+        }
         n = batch[key].shape[0]
         if target is None:
             target = n + (-n % dp)
@@ -590,6 +627,7 @@ def _padded_batches(loader, mesh: Mesh, key: str):
             }
         mask = np.arange(t) < n
         batch = mesh_lib.shard_batch(batch, mesh)
+        batch.update(passthrough)
         mask = mesh_lib.put_sharded(
             mask, mesh_lib.batch_sharding(mesh, extra_dims=0)
         )
@@ -631,7 +669,7 @@ def evaluate_lm(
         @jax.jit
         def batch_ce(params, batch, mask):
             tokens = batch[input_key]
-            inputs = tokens if input_transform is None else input_transform(tokens)
+            inputs = _apply_input_transform(input_transform, tokens, batch)
             hidden = model.apply(
                 {"params": params}, inputs, train=False, return_hidden=True
             )
@@ -646,7 +684,7 @@ def evaluate_lm(
         @jax.jit
         def batch_ce(params, batch, mask):
             tokens = batch[input_key]
-            inputs = tokens if input_transform is None else input_transform(tokens)
+            inputs = _apply_input_transform(input_transform, tokens, batch)
             logits = model.apply({"params": params}, inputs, train=False)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tokens[:, 1:]
@@ -692,12 +730,10 @@ def evaluate(model, state: TrainState, loader, mesh: Mesh | None = None,
     @jax.jit
     def count_correct(params, batch_stats, batch, mask):
         variables = {"params": params, "batch_stats": batch_stats}
-        inputs = batch[input_key]
-        if input_transform is not None:
-            # same in-graph hook as make_train_step: a model trained on
-            # device_normalize'd uint8 would otherwise silently score raw
-            # 0..255 inputs here (ADVICE r2)
-            inputs = input_transform(inputs)
+        # same in-graph hook as make_train_step: a model trained on
+        # device_normalize'd uint8 would otherwise silently score raw
+        # 0..255 inputs here (ADVICE r2)
+        inputs = _apply_input_transform(input_transform, batch[input_key], batch)
         logits = model.apply(variables, inputs, train=False)
         hit = jnp.argmax(logits, axis=-1) == batch[label_key]
         # the denominator comes from the SAME global mask as the numerator,
