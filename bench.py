@@ -533,14 +533,26 @@ def _run_leg_subprocess(name: str, budget_s: float) -> bool:
                   file=sys.stderr, flush=True)
         return rc == 0
     except subprocess.TimeoutExpired:
+        # SIGTERM first with a short grace so a child mid-write can finish
+        # its newline-terminated JSON metric line (children share this
+        # process's stdout; a SIGKILL mid-write could leave a truncated
+        # line and corrupt the one-JSON-line-per-metric contract), then
+        # SIGKILL whatever is left of the subtree
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(proc.pid, signal.SIGTERM)
         except ProcessLookupError:
             pass
         try:
-            proc.wait(timeout=30)
+            proc.wait(timeout=15)
         except subprocess.TimeoutExpired:
-            pass
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
         print(
             f"bench: leg group '{name}' exceeded its {budget_s:.0f}s budget "
             "(attach wedge) — killed; continuing with the remaining legs",
@@ -559,6 +571,13 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.leg is not None:
+        # a graceful SIGTERM (the parent's budget-expiry first shot): raise
+        # SystemExit so python flushes stdout/atexit — the grace period in
+        # _run_leg_subprocess is only useful if the child actually handles
+        # the signal (the default disposition would die as abruptly as KILL)
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
         fn, _ = _LEG_GROUPS[args.leg]
         if not _attach_alive():
             print(f"bench: leg group '{args.leg}' skipped — device probe "

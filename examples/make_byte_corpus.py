@@ -75,6 +75,10 @@ def main() -> None:
     ap.add_argument("--val_frac", type=int, default=16,
                     help="1/N of files (by hash) go to validation")
     args = ap.parse_args()
+    if args.val_frac < 2:
+        ap.error(f"--val_frac must be >= 2 (got {args.val_frac}): 1/N of "
+                 "files go to validation, so N=1 would put EVERY file in "
+                 "val and N<=0 is undefined")
 
     budget = int(args.max_mb * 1e6)
     train_parts: list[bytes] = []
@@ -97,6 +101,18 @@ def main() -> None:
         if total >= budget:
             break
 
+    # validate BOTH splits before writing EITHER file: a tiny --max_mb
+    # budget can fill one split before the hash ever routes a file to the
+    # other, and writing the good split first would leave a fresh train
+    # .bin silently pairing with a stale val .bin from an earlier run
+    empty = [n for n, p in (("train", train_parts), ("val", val_parts)) if not p]
+    if empty:
+        raise SystemExit(
+            f"make_byte_corpus: the {'/'.join(empty)} split is EMPTY "
+            f"(budget {args.max_mb} MB consumed before any file hashed "
+            "into it) — raise --max_mb or adjust --val_frac; nothing "
+            "was written"
+        )
     for name, parts in (("train", train_parts), ("val", val_parts)):
         blob = b"\x00".join(parts)  # NUL = doc separator (NUL-bearing files were filtered)
         tokens = np.frombuffer(blob, np.uint8).astype(np.uint16)

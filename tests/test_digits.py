@@ -2,6 +2,7 @@
 (tpudist/data/digits.py)."""
 
 import numpy as np
+import pytest
 
 from tpudist.data.digits import load_digits_dataset
 
@@ -30,6 +31,7 @@ def test_split_is_disjoint_and_deterministic():
     np.testing.assert_array_equal(a["label"], a2["label"])
 
 
+@pytest.mark.slow  # real convergence run (~minutes on one CPU core)
 def test_trains_above_chance_quickly():
     import jax.numpy as jnp
     import optax

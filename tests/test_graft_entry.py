@@ -12,6 +12,9 @@ bring up all legs. Simulates the reference's multi-machine recipe
 import os
 import subprocess
 import sys
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess world: cold-compiles its own jax programs
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -13,6 +13,9 @@ import subprocess
 import sys
 import textwrap
 import time
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess world: cold-compiles its own jax programs
 
 
 def _run_launcher(tmp_path, extra_args, script_body, script_args=()):

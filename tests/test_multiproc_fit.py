@@ -19,6 +19,9 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess world: cold-compiles its own jax programs
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

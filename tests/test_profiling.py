@@ -47,6 +47,25 @@ def test_repeat_cycles_run_and_then_stop(tmp_path):
     assert len(_trace_dirs(tmp_path)) >= 1
 
 
+def test_trace_contains_python_stacks_and_step_annotations(tmp_path):
+    """with_stack parity (/root/reference/main.py:77): a captured window
+    must carry host-side python-tracer events and the per-step TraceMe
+    annotation, not just the device timeline."""
+    p = WindowedProfiler("T", wait=0, warmup=0, active=4, repeat=1,
+                         log_dir=tmp_path)
+    x = jnp.arange(8.0)
+    with p:
+        for i in range(6):
+            with p.annotate(i):
+                jax.block_until_ready(jnp.sum(x * x))
+            p.step()
+    blob = b"".join(
+        f.read_bytes() for d in _trace_dirs(tmp_path) for f in d.rglob("*.pb")
+    )
+    assert b"python" in blob  # the python-tracer (with_stack) host plane
+    assert b"tpudist_train" in blob  # StepTraceAnnotation events
+
+
 def test_short_run_flushes_open_window_on_exit(tmp_path):
     """A run that ends mid-window still writes its trace (the reference's
     profiler context flushes on __exit__ the same way)."""

@@ -8,6 +8,9 @@ import os
 import subprocess
 import sys
 import textwrap
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess world: cold-compiles its own jax programs
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -181,6 +181,28 @@ def put_sharded(x: np.ndarray, sharding: NamedSharding) -> jax.Array:
     return jax.make_array_from_process_local_data(sharding, x)
 
 
+def check_reserved_device_keys(batch) -> None:
+    """Enforce the ``"_"``-prefix contract: reserved keys are per-step
+    DEVICE operands (the DeviceCachedLoader's ``"_cache"``), so a host
+    value under the prefix — a foreign loader using underscores for
+    ordinary metadata — would silently bypass staging/padding; refuse it
+    loudly instead. One home for the check used by ``shard_batch``,
+    ``make_train_step.stage`` and the eval padding path."""
+    if not isinstance(batch, dict):
+        return
+    bad = {
+        k for k, v in batch.items()
+        if k.startswith("_") and not isinstance(v, jax.Array)
+    }
+    if bad:
+        raise TypeError(
+            f"batch keys {sorted(bad)} start with '_' (the reserved "
+            "device-operand prefix) but hold host values, which would "
+            "bypass staging and padding — rename them, or device_put "
+            "them if they really are per-step device operands"
+        )
+
+
 def shard_batch(batch, mesh: Mesh):
     """Place a host-local batch (numpy pytree) onto the mesh, sharded over
     the batch dimension.
@@ -190,7 +212,10 @@ def shard_batch(batch, mesh: Mesh):
     ``tpudist.train._apply_input_transform``), not row data: they pass
     through untouched. Without the exemption, ``np.asarray`` would fetch
     the whole HBM cache to host and re-upload it batch-sharded on every
-    batch."""
+    batch. The exemption is for device-resident values ONLY
+    (:func:`check_reserved_device_keys` refuses host values under the
+    prefix)."""
+    check_reserved_device_keys(batch)
     if isinstance(batch, dict):
         passthrough = {k: v for k, v in batch.items() if k.startswith("_")}
         rows = {k: v for k, v in batch.items() if k not in passthrough}

@@ -11,10 +11,19 @@ played by the XLA runtime's own instrumentation; SURVEY.md §2.10).
 
 Usage mirrors the reference: wrap training in the context manager and call
 ``p.step()`` once per iteration.
+
+``with_stack=True`` (the default, matching the reference's
+``with_stack=True`` at /root/reference/main.py:77) turns on the profiler's
+python tracer, so captured windows carry host-side python call stacks
+alongside the device timeline — the Kineto python-stack capability,
+natively. :meth:`annotate` additionally brackets each traced step in a
+``StepTraceAnnotation`` so XProf's step-time view can attribute device work
+to training steps.
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from pathlib import Path
 
@@ -34,6 +43,7 @@ class WindowedProfiler:
         repeat: int = 1,
         log_dir: str | Path | None = None,
         enabled: bool = True,
+        with_stack: bool = True,
     ):
         # torch semantics: skip `wait`, then `warmup` (instrument, discard),
         # then record `active` steps; `repeat` cycles. jax.profiler has no
@@ -44,6 +54,7 @@ class WindowedProfiler:
         self.repeat = repeat
         self.log_dir = str(log_dir if log_dir is not None else f"./log_{job_id}")
         self.enabled = enabled
+        self.with_stack = with_stack
         self._step = 0
         self._cycle = 0
         self._tracing = False
@@ -57,8 +68,23 @@ class WindowedProfiler:
 
     def _start(self) -> None:
         Path(self.log_dir).mkdir(parents=True, exist_ok=True)
-        jax.profiler.start_trace(self.log_dir)
+        options = None
+        if self.with_stack:
+            options = jax.profiler.ProfileOptions()
+            options.python_tracer_level = 1
+            options.host_tracer_level = 2
+        jax.profiler.start_trace(self.log_dir, profiler_options=options)
         self._tracing = True
+
+    def annotate(self, step_num: int):
+        """Context manager bracketing one training step: a
+        ``StepTraceAnnotation`` while a window is recording (XProf's
+        step-time attribution), a no-op otherwise."""
+        if self._tracing:
+            return jax.profiler.StepTraceAnnotation(
+                "tpudist_train", step_num=step_num
+            )
+        return contextlib.nullcontext()
 
     def step(self) -> None:
         """Advance the schedule; call once per training iteration

@@ -320,6 +320,7 @@ def make_train_step(
         ``[grad_accum, micro, ...]`` *before* staging, so each device keeps
         contiguous rows of every microbatch and no resharding is needed.
         """
+        mesh_lib.check_reserved_device_keys(batch)
         out = {}
         for k, v in batch.items():
             if isinstance(v, jax.Array):
@@ -550,7 +551,8 @@ def fit(
                     ):
                         start = time.time()
                         global_step += 1
-                        state, metrics = step(state, batch)
+                        with p.annotate(global_step):
+                            state, metrics = step(state, batch)
                         loss_dev = metrics["loss"]
                         loss_dev.copy_to_host_async()
                         if pending is not None:
@@ -605,6 +607,7 @@ def _padded_batches(loader, mesh: Mesh, key: str):
         # host and "padding" them. Only the reserved prefix is exempt — a
         # foreign loader yielding jax.Arrays for ordinary row data keeps
         # the old np.asarray path.
+        mesh_lib.check_reserved_device_keys(batch)
         passthrough = {
             k: v for k, v in batch.items() if k.startswith("_")
         }
