@@ -224,12 +224,14 @@ def main(argv=None):
         """Model per the CLI flags; the scan/remat layout is a parameter so
         the remote-compile fallback below can rebuild unrolled."""
         if args.pipe > 1:
-            # PipelinedGPT2 builds its blocks with tp=False (shard_map manual
-            # mesh), so tensor metadata would be silently inert — reject
-            # rather than mislead
-            if args.experts or args.attn in ("ring", "ulysses", "ulysses_flash") or args.tensor > 1:
+            # --pipe composes with data AND tensor parallelism (the pipeline
+            # shard_map is manual over 'pipe' only; Megatron tensor shardings
+            # ride the stacked params under GSPMD — tpudist.parallel.pp);
+            # MoE/context-parallel attention are not pipelined
+            if args.experts or args.attn in ("ring", "ulysses", "ulysses_flash"):
                 raise SystemExit(
-                    "--pipe composes with data parallelism only (stacked blocks)"
+                    "--pipe composes with --tensor and data parallelism; "
+                    "MoE/context-parallel attention are not pipelined"
                 )
             if args.dropout:
                 raise SystemExit("--dropout is not supported with --pipe")
@@ -244,6 +246,7 @@ def main(argv=None):
                 mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
                 max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
                 depth=args.depth, num_heads=args.num_heads, dtype=dtype,
+                attn_impl=args.attn,
             )
         if args.arch == "llama":
             from tpudist.models.llama import Llama
@@ -331,12 +334,20 @@ def main(argv=None):
     if args.init_hf:
         from tpudist.interop import load_hf_params
 
-        if args.pipe > 1:
-            raise SystemExit("--init_hf supports the non-pipe models")
         init_params = load_hf_params(
             args.init_hf, arch=args.arch, depth=args.depth,
             num_heads=args.num_heads, num_kv_heads=args.num_kv_heads or None,
         )
+        if args.pipe > 1:
+            # re-layout the unrolled HF params into the pipelined stacked
+            # form (pure re-indexing — same function, now layer-over-stage)
+            from flax import linen as nn
+
+            from tpudist.models.gpt2 import stack_gpt2_params
+
+            init_params = nn.meta.unbox(
+                stack_gpt2_params(init_params, args.depth)["params"]
+            )
 
     import time
 

@@ -121,17 +121,113 @@ def test_pipelined_gpt2_train_step():
     assert losses[-1] < losses[0]
 
 
-def test_pipelined_matches_plain_gpt2_shapes():
-    from tpudist.models.gpt2 import PipelinedGPT2
+_GPT2_CFG = dict(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=4,
+                 num_heads=4)
 
-    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=2, pipe=4))
-    model = PipelinedGPT2(
-        mesh, num_micro=2, vocab_size=64, max_seq_len=16,
-        hidden_dim=32, depth=4, num_heads=4,
-    )
-    tokens = jnp.zeros((4, 16), jnp.int32)
-    variables = jax.jit(model.init)(jax.random.key(0), tokens)
+
+def test_pipelined_gpt2_matches_plain_numerically():
+    """PipelinedGPT2 computes the IDENTICAL function as same-seed plain
+    GPT2: init-by-conversion (stack_gpt2_params) re-layouts the same param
+    leaves, and the GPipe schedule is an execution order, not a numerical
+    change — so logits and loss must agree to float tolerance."""
     from flax import linen as nn
 
-    logits = model.apply(nn.meta.unbox(variables), tokens)
-    assert logits.shape == (4, 16, 64)
+    from tpudist.models.gpt2 import GPT2, PipelinedGPT2
+    from tpudist.train import lm_loss
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=2, pipe=4))
+    plain = GPT2(**_GPT2_CFG)
+    piped = PipelinedGPT2(mesh, num_micro=4, **_GPT2_CFG)
+    rng = np.random.Generator(np.random.PCG64(7))
+    tokens = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+
+    v_plain = nn.meta.unbox(plain.init(jax.random.key(0), tokens))
+    v_piped = nn.meta.unbox(piped.init(jax.random.key(0), tokens))
+    logits_plain = plain.apply(v_plain, tokens, train=False)
+    logits_piped = jax.jit(
+        lambda v, t: piped.apply(v, t, train=False)
+    )(v_piped, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits_piped), np.asarray(logits_plain),
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        float(lm_loss(logits_piped, tokens)),
+        float(lm_loss(logits_plain, tokens)), rtol=1e-5,
+    )
+
+
+def test_pipelined_train_step_agrees_with_dp():
+    """Same-seed PP and DP train steps report the same loss — the local
+    mirror of the dryrun's PP agreement leg."""
+    from tpudist.models.gpt2 import GPT2, PipelinedGPT2
+    from tpudist.train import (
+        create_train_state, lm_loss, make_train_step, state_shardings_of,
+    )
+
+    rng = np.random.Generator(np.random.PCG64(3))
+    batch = {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+
+    def first_loss(mesh, model):
+        tx = optax.adam(1e-3)
+        state = create_train_state(
+            model, 0, jnp.zeros((8, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", state_sharding=state_shardings_of(state),
+        )
+        _, metrics = step(state, batch)
+        return float(metrics["loss"])
+
+    loss_dp = first_loss(mesh_lib.create_mesh(), GPT2(**_GPT2_CFG))
+    mesh_pp = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=2, pipe=4))
+    loss_pp = first_loss(
+        mesh_pp, PipelinedGPT2(mesh_pp, num_micro=4, **_GPT2_CFG)
+    )
+    assert abs(loss_pp - loss_dp) / abs(loss_dp) < 2e-5
+
+
+def test_pipelined_gpt2_with_tensor_parallel_stages():
+    """PP x TP: the pipe-manual shard_map leaves 'tensor' under GSPMD, so
+    Megatron-sharded stage params must still compute the plain model's
+    function (parallel/pp.py's composition claim, made real)."""
+    from flax import linen as nn
+
+    from tpudist.models.gpt2 import GPT2, PipelinedGPT2
+    from tpudist.train import (
+        create_train_state, lm_loss, make_train_step, state_shardings_of,
+    )
+
+    mesh = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(data=2, pipe=2, tensor=2)
+    )
+    model = PipelinedGPT2(mesh, num_micro=4, **_GPT2_CFG)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((8, 16), jnp.int32), tx, mesh
+    )
+    # stage params must be BOTH pipe-sharded (layer dim) and tensor-sharded
+    # (Megatron dims): qkv kernel [depth, d, 3, heads, dh] -> ('pipe', ...,
+    # 'tensor', ...)
+    spec = state.params["blocks"]["qkv"]["kernel"].sharding.spec
+    assert spec[0] == mesh_lib.PIPELINE_AXIS
+    assert mesh_lib.TENSOR_AXIS in tuple(spec)
+
+    rng = np.random.Generator(np.random.PCG64(3))
+    batch = {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+    )
+    _, metrics = step(state, batch)
+    loss_pptp = float(metrics["loss"])
+
+    # DP reference: same seed, same batch, plain model on the pure-DP mesh
+    plain = GPT2(**_GPT2_CFG)
+    v_plain = nn.meta.unbox(plain.init(jax.random.key(0), batch["tokens"]))
+    loss_ref = float(
+        lm_loss(plain.apply(v_plain, batch["tokens"], train=False),
+                batch["tokens"])
+    )
+    assert abs(loss_pptp - loss_ref) / abs(loss_ref) < 2e-5

@@ -288,6 +288,50 @@ def gpt2_large(**kw) -> GPT2:
 from tpudist.models.lm_utils import chunked_lm_forward  # noqa: E402,F401
 
 
+def stack_gpt2_params(variables, depth: int):
+    """Convert a plain (unrolled) :class:`GPT2` param tree into
+    :class:`PipelinedGPT2`'s stacked layout.
+
+    The per-layer subtrees ``h_0 .. h_{depth-1}`` are stacked leaf-for-leaf
+    into ``blocks`` with a new leading ``[depth]`` dim boxed over ``pipe``;
+    boxed leaves keep their tensor-axis names shifted past the layer dim
+    (Megatron TP-within-stage), and ``wte``/``wpe``/``ln_f`` pass through
+    with their boxes. Because this is a pure re-layout, a
+    ``PipelinedGPT2`` holding the converted params computes the *identical
+    function* as the source model — the property the PP agreement
+    certification relies on, and what enables warm-starting the pipelined
+    model from an unrolled checkpoint (``examples/train_gpt2.py`` routes
+    ``--init_hf --pipe`` through this conversion). Accepts boxed or
+    unboxed trees, and a full ``{"params": ...}`` variables dict or a bare
+    param tree.
+    """
+    p = variables["params"] if "params" in variables else variables
+
+    def is_box(x):
+        return isinstance(x, nn.Partitioned)
+
+    def stack(*leaves):
+        if is_box(leaves[0]):
+            vals = [leaf.value for leaf in leaves]
+            names = leaves[0].names
+        else:
+            vals = list(leaves)
+            names = (None,) * jnp.ndim(leaves[0])
+        return nn.Partitioned(jnp.stack(vals), names=(PIPELINE_AXIS, *names))
+
+    blocks = jax.tree_util.tree_map(
+        stack, *[p[f"h_{i}"] for i in range(depth)], is_leaf=is_box
+    )
+    return {
+        "params": {
+            "wte": p["wte"],
+            "wpe": p["wpe"],
+            "blocks": blocks,
+            "ln_f": p["ln_f"],
+        }
+    }
+
+
 class PipelinedGPT2:
     """GPT-2 with its blocks stacked ``[depth, ...]`` and run through GPipe
     microbatch pipelining over the ``pipe`` mesh axis
@@ -296,10 +340,20 @@ class PipelinedGPT2:
     Duck-types the flax ``init``/``apply`` surface that
     ``tpudist.train.create_train_state``/``make_train_step`` drive, so the
     ordinary compiled train step works unchanged: ``init`` boxes the stacked
-    block params with ``nn.Partitioned(('pipe', None, ...))`` metadata, which
+    block params with ``nn.Partitioned(('pipe', ...))`` metadata, which
     ``create_train_state`` turns into layer-over-stage placement (and
     matching Adam-moment shardings); ``apply`` embeds, pipelines the blocks,
     and runs the stage-replicated final LayerNorm + weight-tied head.
+
+    ``init`` is *init-by-conversion*: it initializes the plain unrolled
+    :class:`GPT2` twin with the caller's rng and re-stacks its params
+    (:func:`stack_gpt2_params`), so the same seed yields the same function
+    as the plain model — making PP certifiable against the DP reference
+    (and every Adam update identical, since the stacked layout is a pure
+    re-indexing of the same leaves). The blocks' Megatron ``tensor``
+    shardings survive the conversion, and the pipeline's ``shard_map`` is
+    manual over ``pipe`` only, so PP×TP (and ×DP) composes under GSPMD —
+    see ``tpudist.parallel.pp``.
 
     Embedding/head stay outside the pipeline (computed replicated over
     ``pipe``) — standard for shallow heads; the depth is where the memory is.
@@ -329,35 +383,21 @@ class PipelinedGPT2:
         self.hidden_dim = hidden_dim
         self.depth = depth
         self.dtype = dtype
+        # the unrolled twin: the source of init (same seed -> same function)
+        self.unrolled = GPT2(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            hidden_dim=hidden_dim, depth=depth, num_heads=num_heads,
+            dtype=dtype, attn_impl=attn_impl,
+        )
+        # partitioning metadata on the apply-side Block is irrelevant (its
+        # initializers never run — params arrive pre-boxed from the
+        # conversion), so tp=False keeps the module free of boxing logic
         self.block = Block(num_heads, dtype=dtype, attn_impl=attn_impl, tp=False)
 
     def init(self, rng, tokens, train: bool = False):
-        r_wte, r_wpe, r_blocks = jax.random.split(rng, 3)
-        d = self.hidden_dim
-        sample = jnp.zeros((1, int(tokens.shape[-1]), d), self.dtype)
-        # per-layer init, unboxed (the Blocks' tensor-axis boxes would be
-        # off-by-one after stacking), then re-boxed layer-dim-over-'pipe'
-        stacked = jax.vmap(
-            lambda r: nn.meta.unbox(self.block.init(r, sample)["params"])
-        )(jax.random.split(r_blocks, self.depth))
-        blocks = jax.tree_util.tree_map(
-            lambda a: nn.Partitioned(
-                a, names=(PIPELINE_AXIS,) + (None,) * (a.ndim - 1)
-            ),
-            stacked,
+        return stack_gpt2_params(
+            self.unrolled.init(rng, tokens, train=train), self.depth
         )
-        params = {
-            "wte": nn.initializers.normal(0.02)(
-                r_wte, (self.vocab_size, d), jnp.float32
-            ),
-            "wpe": nn.initializers.normal(0.01)(
-                r_wpe, (self.max_seq_len, d), jnp.float32
-            ),
-            "blocks": blocks,
-            "ln_f": {"scale": jnp.ones((d,), jnp.float32),
-                     "bias": jnp.zeros((d,), jnp.float32)},
-        }
-        return {"params": params}
 
     def apply(self, variables, tokens, train: bool = True):
         p = variables["params"]

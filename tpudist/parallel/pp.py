@@ -25,10 +25,17 @@ recipe, not a torch-style stage-process scheduler):
   yields the full backward pipeline, with XLA scheduling the reverse-order
   hops.
 
-Composition with the other axes falls out of the mesh: the microbatch dim is
-sharded over ``data``/``fsdp`` (each stage computes on its data shard), and
-stacked block params may additionally carry ``tensor`` annotations on their
-trailing dims for TP-within-stage.
+Composition with the other axes falls out of the mesh: the ``shard_map`` is
+manual over ``pipe`` ONLY (``axis_names={'pipe'}``) — every other mesh axis
+stays under GSPMD control inside the schedule. The microbatch dim rides its
+``data``/``fsdp`` sharding (each stage computes on its data shard), and
+stacked block params may additionally carry ``tensor`` shardings on their
+trailing dims for Megatron TP-within-stage: GSPMD inserts the per-block
+all-reduces from the param shardings exactly as it does for the unrolled
+model, while ``ppermute`` hops activations down the ``pipe`` ring. The
+``data x pipe x tensor`` composition is certified against the same-function
+DP reference in ``__graft_entry__.dryrun_multichip`` and
+``tests/test_pipeline.py``.
 """
 
 from __future__ import annotations
@@ -69,12 +76,13 @@ def _pipeline_local(
     *,
     axis_name: str,
 ):
-    """Per-stage GPipe schedule — runs inside ``shard_map``.
+    """Per-stage GPipe schedule — runs inside the pipe-manual ``shard_map``.
 
-    ``params_local``: this stage's layer slice, leaves ``[L/S, ...]``.
-    ``x_local``: all microbatches of this device's data shard,
-    ``[num_micro, micro_batch, ...]`` (replicated over ``pipe``).
-    Returns the pipeline output for every microbatch, same shape as
+    ``params_local``: this stage's layer slice, leaves ``[L/S, ...]``
+    (still sharded over auto axes, e.g. ``tensor``, which GSPMD handles).
+    ``x_local``: all microbatches, ``[num_micro, micro_batch, ...]``
+    (replicated over ``pipe``; ``data``-sharded on the microbatch dim under
+    GSPMD). Returns the pipeline output for every microbatch, same shape as
     ``x_local`` (valid on every stage — the last stage's results are
     ``psum``-broadcast over the ``pipe`` axis).
     """
@@ -143,6 +151,13 @@ def pipeline_apply(
     ``n_layers`` must divide by the mesh's ``pipe`` size. ``x``:
     ``[batch, ...]`` with ``batch`` divisible by ``num_micro`` (and the
     microbatch by the ``data`` sharding).
+
+    The ``shard_map`` is manual over ``pipe`` only: the batch keeps its
+    ``data`` sharding and the params their ``tensor`` sharding under GSPMD
+    inside the schedule, so DP and Megatron-TP compose with the pipeline
+    without hand-written collectives. ``batch_axes`` names the mesh axes
+    the microbatch dim is constrained to (the ``with_sharding_constraint``
+    below) — override it for a custom batch layout.
     """
     n_stages = mesh.shape[axis]
     layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -152,13 +167,20 @@ def pipeline_apply(
     if b % num_micro:
         raise ValueError(f"batch {b} not divisible by num_micro {num_micro}")
     xm = x.reshape(num_micro, b // num_micro, *x.shape[1:])
+    # pin the microbatch dim's data sharding (GSPMD would usually propagate
+    # it from the embedding output, but the constraint makes the layout
+    # deterministic: microbatch rows stay on the device that computes them)
+    xm = jax.lax.with_sharding_constraint(
+        xm, NamedSharding(mesh, P(None, batch_axes, *([None] * (x.ndim - 1))))
+    )
 
-    x_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+    x_spec = P(*([None] * (x.ndim + 1)))
     fn = shard_map(
         functools.partial(_pipeline_local, block_fn, axis_name=axis),
         mesh=mesh,
         in_specs=(stacked_param_specs(stacked_params, axis=axis), x_spec),
         out_specs=x_spec,
+        axis_names={axis},
     )
     out = fn(stacked_params, xm)
     return out.reshape(b, *out.shape[2:])
