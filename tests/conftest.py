@@ -25,7 +25,13 @@ jax.config.update("jax_threefry_partitionable", True)
 
 # persistent compilation cache: the suite re-jits the same train steps many
 # times (each fit() in its own test); caching compiled executables across
-# tests and across runs cuts the suite from ~10min to ~2min on CPU
-jax.config.update("jax_compilation_cache_dir", "/tmp/tpudist_jax_cache")
+# tests and across runs cuts the suite from ~10min to ~2min on CPU.
+# The dir is keyed by a hash of the host's CPU flags: XLA:CPU AOT results
+# only WARN on a feature mismatch and then can SIGABRT mid-run (observed
+# after a host migration under this environment's VM scheduler) — a
+# per-feature-set dir turns that crash into a cold compile.
+from tpudist.utils.cache import host_keyed_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", host_keyed_cache_dir())
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,37 @@
+"""Host-keyed persistent-compile-cache location.
+
+XLA:CPU serializes AOT-compiled executables with the *compile* machine's
+feature set; loading them on a host with different CPU features only logs a
+warning ("could lead to execution errors such as SIGILL") and then can
+SIGABRT mid-run — observed in this environment when the VM migrated to a
+host with a different AVX feature mix while ``/tmp``'s cache survived.
+Keying the cache directory by the host's CPU flags turns that crash into a
+cold compile on the new host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def host_cpu_fingerprint() -> str:
+    """Short stable hash of this host's CPU feature flags."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        import platform
+
+        flags = platform.machine() + platform.processor()
+    return hashlib.sha1(flags.encode()).hexdigest()[:10]
+
+
+def host_keyed_cache_dir(base: str = "/tmp/tpudist_jax_cache") -> str:
+    return os.environ.get(
+        "TPUDIST_JAX_CACHE_DIR", f"{base}_{host_cpu_fingerprint()}"
+    )
