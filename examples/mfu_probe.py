@@ -1,0 +1,117 @@
+"""Per-GEMM MXU-utilization probe — the measurement behind docs/PERF.md §4b.
+
+The GPT-2 124M training step is kernel-efficiency-limited at hidden=768
+(PERF §4): this probe quantifies WHERE by timing each GEMM shape of the
+step in isolation on the attached chip, plus the same block mix at wider
+hidden sizes (the "would a bigger model hit higher MFU" experiment).
+
+Method: each shape runs inside ONE jitted ``lax.scan`` of ``iters``
+matmuls whose left operand is scaled per-iteration (defeats loop-invariant
+hoisting) and accumulated (defeats dead-code elimination); timing is
+sync'd by fetching a scalar of the result (the remote-attach
+block_until_ready hazard — see bench.py). Per-shape report: achieved
+TFLOP/s and fraction of the chip's bf16 peak.
+
+Run on the bench chip::
+
+    python examples/mfu_probe.py            # per-GEMM table + hidden sweep
+    python examples/mfu_probe.py --peak 197e12
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# v5e bf16 peak; override with --peak for other chips
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+def time_gemm(m: int, k: int, n: int, *, iters: int = 24, reps: int = 3) -> float:
+    """Median achieved FLOP/s for a bf16 [m,k]x[k,n] matmul."""
+    rng = np.random.Generator(np.random.PCG64(0))
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.bfloat16)
+    scales = jnp.asarray(1.0 + np.arange(iters) * 1e-6, jnp.bfloat16)
+
+    @jax.jit
+    def run(x, w, scales):
+        def body(acc, s):
+            # per-iter scaled operand: the matmul cannot be hoisted out of
+            # the loop, and the accumulation keeps every iteration live
+            return acc + (x * s) @ w, None
+
+        acc0 = jnp.zeros((m, n), jnp.float32)
+        acc, _ = jax.lax.scan(body, acc0, scales)
+        return acc[0, 0]
+
+    run(x, w, scales).block_until_ready()  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(run(x, w, scales))  # value fetch = real sync on remote attach
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    return 2.0 * m * k * n * iters / dt
+
+
+def gpt2_step_shapes(tokens: int, hidden: int, vocab: int = 50257,
+                     ce_chunk_rows: int = 4096) -> list[tuple[str, int, int, int]]:
+    """The GEMM shapes of one GPT-2 block + tied head, forward and the two
+    backward passes (dgrad/wgrad) per GEMM, at ``tokens`` rows."""
+    t, d = tokens, hidden
+    fwd = [
+        ("qkv", t, d, 3 * d),
+        ("attn_out", t, d, d),
+        ("mlp_fc", t, d, 4 * d),
+        ("mlp_proj", t, 4 * d, d),
+        ("lm_head(chunk)", ce_chunk_rows, d, vocab),
+    ]
+    shapes = []
+    for name, m, k, n in fwd:
+        shapes.append((f"{name} fwd", m, k, n))
+        shapes.append((f"{name} dgrad", m, n, k))
+        shapes.append((f"{name} wgrad", k, m, n))
+    return shapes
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--peak", type=float, default=DEFAULT_PEAK_FLOPS,
+                    help="chip bf16 peak FLOP/s (default v5e 197e12)")
+    ap.add_argument("--tokens", type=int, default=8192,
+                    help="GEMM rows = microbatch tokens of the bench step "
+                    "(8 seqs x 1024)")
+    ap.add_argument("--sweep", default="768,1024,1536,2048",
+                    help="hidden sizes for the wider-GEMM block-mix sweep")
+    args = ap.parse_args()
+
+    print(f"# per-GEMM MXU utilization at tokens={args.tokens} "
+          f"(bf16, peak {args.peak / 1e12:.0f} TFLOP/s)")
+    print(f"{'shape':24s} {'M':>7s} {'K':>6s} {'N':>6s} "
+          f"{'TFLOP/s':>8s} {'%peak':>6s}")
+    for name, m, k, n in gpt2_step_shapes(args.tokens, 768):
+        fl = time_gemm(m, k, n)
+        print(f"{name:24s} {m:7d} {k:6d} {n:6d} "
+              f"{fl / 1e12:8.1f} {100 * fl / args.peak:5.1f}%")
+
+    print("\n# block GEMM mix vs hidden width (same shapes, wider d)")
+    print(f"{'hidden':>6s} {'weighted TFLOP/s':>16s} {'%peak':>6s}")
+    for d in [int(s) for s in args.sweep.split(",")]:
+        total_flops, total_time = 0.0, 0.0
+        for name, m, k, n in gpt2_step_shapes(args.tokens, d)[:-3]:
+            # block GEMMs only (head excluded: its width is vocab-fixed)
+            fl = time_gemm(m, k, n, iters=12, reps=2)
+            f = 2.0 * m * k * n
+            total_flops += f
+            total_time += f / fl
+        eff = total_flops / total_time
+        print(f"{d:6d} {eff / 1e12:16.1f} {100 * eff / args.peak:5.1f}%")
+
+
+if __name__ == "__main__":
+    main()

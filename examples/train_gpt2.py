@@ -50,6 +50,10 @@ def parse_args(argv=None):
     p.add_argument("--clip_norm", default=1.0, type=float)
     p.add_argument("--grad_accum", default=1, type=int)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--amp", action="store_true",
+                   help="mixed precision end-to-end (tpudist.amp): bf16 "
+                   "compute policy (implies --bf16) + non-finite update "
+                   "guard on the optimizer")
     p.add_argument("--dropout", default=0.0, type=float,
                    help="embedding+residual dropout rate (GPT-2 paper: 0.1)")
     p.add_argument("--remat", action="store_true",
@@ -114,6 +118,9 @@ def parse_args(argv=None):
                    "from the start of the stream (greedy unless --temperature)")
     p.add_argument("--temperature", default=0.0, type=float)
     p.add_argument("--top_k", default=None, type=int)
+    p.add_argument("--top_p", default=None, type=float,
+                   help="nucleus sampling: keep the smallest token set "
+                   "with cumulative probability >= p")
     p.add_argument("--eval", action="store_true",
                    help="after training, report next-token loss + perplexity "
                    "over --val_tokens (or the training stream if unset)")
@@ -218,7 +225,7 @@ def main(argv=None):
             expert=max(expert_axis, 1),
         )
     )
-    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    dtype = jnp.bfloat16 if (args.bf16 or args.amp) else jnp.float32
 
     def build_model(scan_layers: bool, remat_layers: bool):
         """Model per the CLI flags; the scan/remat layout is a parameter so
@@ -305,6 +312,7 @@ def main(argv=None):
                      warmup_steps=args.warmup_steps),
         optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
+        skip_nonfinite_updates=args.amp,
     )
 
     def build_forward_loss(mdl):
@@ -431,6 +439,12 @@ def main(argv=None):
             f"tokens/sec: {seqs * args.seq_len / wall:.1f} "
             f"(global, incl. compile) steps={n_steps} final_loss={losses[-1]:.4f}"
         )
+    if args.amp and ctx.process_index == 0:
+        from tpudist.amp import skipped_steps
+
+        skipped = skipped_steps(state.opt_state)
+        if skipped:
+            print(f"amp: skipped {skipped} non-finite update step(s)")
 
     if args.generate:
         # EVERY process runs the (collective) jitted decode — params are
@@ -445,6 +459,7 @@ def main(argv=None):
         out = generate(
             model, state.params, prompt, args.generate,
             temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p,
         )[0]
         if ctx.process_index == 0:
             print(f"generated tokens: {out.tolist()}")

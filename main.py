@@ -62,7 +62,24 @@ def parse_args(argv=None):
                         help="crop size for --dataset imagenet")
     parser.add_argument("--workers", default=None, type=int,
                         help="decode threads for --dataset imagenet")
+    parser.add_argument("--packed", default=None, type=str,
+                        help="pre-decoded pack prefix for --dataset imagenet "
+                        "(tpudist.data.packed; build once with `python -m "
+                        "tpudist.data.packed --root .../train --out X`) — "
+                        "streams pixels from a uint8 memmap at memcpy speed "
+                        "instead of re-decoding JPEGs every epoch; composes "
+                        "with --device_cache (pack staged to HBM, index-only "
+                        "steps)")
+    parser.add_argument("--packed_val", default=None, type=str,
+                        help="pack prefix for the val split (with --eval); "
+                        "defaults to the image-folder val/ tree")
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    parser.add_argument("--amp", action="store_true",
+                        help="mixed precision END-TO-END (tpudist.amp): the "
+                        "bf16 compute policy (implies --bf16) plus the "
+                        "non-finite update guard — a gradient spike skips "
+                        "one optimizer step (counted) instead of poisoning "
+                        "params and Adam moments")
     parser.add_argument("--stem", default="conv7",
                         choices=["conv7", "space_to_depth"],
                         help="ResNet stem; space_to_depth is the MLPerf TPU "
@@ -87,8 +104,10 @@ def parse_args(argv=None):
                         "0.1); 0 = the reference's plain CE (main.py:79)")
     parser.add_argument("--grad_accum", default=1, type=int)
     parser.add_argument("--augment", action="store_true",
-                        help="standard CIFAR augmentation (crop+flip+"
-                        "normalize); reference default is ToTensor only")
+                        help="train augmentation (crop+flip+normalize); "
+                        "reference default is ToTensor only. Host-side for "
+                        "host loaders; IN-GRAPH (step-keyed crop+flip) with "
+                        "--device_cache or --packed")
     parser.add_argument("--device_cache", action="store_true",
                         help="stage the uint8 dataset to HBM once before "
                         "compile and ship only sampler indices per step "
@@ -133,7 +152,11 @@ def main(argv=None):
     ctx = init_from_env()
     mesh = create_mesh()
 
-    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    # --amp = the named policy (fp32 master params, bf16 compute) + the
+    # overflow guard on the optimizer below; --bf16 alone = dtype only
+    from tpudist.amp import policy_for
+
+    dtype = policy_for(args.bf16 or args.amp).compute_dtype
     # reference keeps the stock 1000-way head even on CIFAR (main.py:40)
     resnets = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
                "resnet101": resnet101, "resnet152": resnet152}
@@ -153,7 +176,50 @@ def main(argv=None):
     per_process_batch = args.batch_size * jax.local_device_count()
     input_transform = None  # set by the --device_cache path
 
-    if args.dataset == "imagenet":
+    if args.dataset == "imagenet" and args.packed:
+        # pre-decoded pack (tpudist.data.packed): pixels stream from a uint8
+        # memmap at memcpy speed — the fix for decode-bound hosts (PERF §3c);
+        # normalization runs in-graph either way (uint8 H2D, 4x less traffic)
+        from tpudist.data.packed import load_packed
+        from tpudist.data.transforms import (
+            IMAGENET_MEAN, IMAGENET_STD, device_normalize,
+        )
+
+        packed = load_packed(args.packed)
+        train_classes = packed["classes"]
+        pdata = {"image": packed["image"], "label": packed["label"]}
+        sampler = DistributedSampler(
+            len(pdata["label"]), num_replicas=ctx.process_count,
+            rank=ctx.process_index,
+        )
+        norm = device_normalize(IMAGENET_MEAN, IMAGENET_STD, dtype=dtype)
+        if args.augment:
+            # packed pixels are the deterministic eval decode; --augment
+            # restores train-time variety IN-GRAPH (reflect-pad crop +
+            # flip, step-keyed) — weaker than streaming RandomResizedCrop
+            # but fresh every epoch at zero host cost
+            from tpudist.data.transforms import (
+                device_compose, device_random_crop_flip,
+            )
+
+            norm = device_compose(
+                device_random_crop_flip(pad=max(args.image_size // 28, 4)),
+                norm,
+            )
+        if args.device_cache:
+            from tpudist.data.device_cache import DeviceCachedLoader
+
+            # staged pre-compile (same contract as the CIFAR path below)
+            loader = DeviceCachedLoader(
+                pdata, per_process_batch, mesh=mesh, sampler=sampler
+            )
+            input_transform = loader.input_transform(norm)
+        else:
+            loader = DataLoader(
+                pdata, per_process_batch, sampler=sampler, transform=None
+            )
+            input_transform = norm
+    elif args.dataset == "imagenet":
         # streaming image-folder pipeline (BASELINE configs 2/3): decode-on-
         # demand with the standard train augmentation; --augment is implied
         from tpudist.data.imagenet import ImageFolderLoader
@@ -164,6 +230,7 @@ def main(argv=None):
             num_replicas=ctx.process_count, rank=ctx.process_index,
             workers=args.workers,
         )
+        train_classes = loader.classes
     else:
         # --- dataset (reference: CIFAR-100 + ToTensor only, main.py:42-51);
         # the model head deliberately stays 1000-way regardless of the
@@ -181,11 +248,6 @@ def main(argv=None):
             rank=ctx.process_index,
         )
         if args.device_cache:
-            if args.augment:
-                raise SystemExit(
-                    "--device_cache gathers in-graph; host-side --augment "
-                    "does not apply (drop one of the two)"
-                )
             from tpudist.data.device_cache import DeviceCachedLoader
 
             # staged HERE — before create_train_state compiles anything —
@@ -194,11 +256,28 @@ def main(argv=None):
             loader = DeviceCachedLoader(
                 data, per_process_batch, mesh=mesh, sampler=sampler
             )
-            # in-graph ToTensor (uint8 → [0,1] float), the reference's
-            # transform (main.py:46) moved into the compiled step
-            input_transform = loader.input_transform(
-                lambda x: x.astype(dtype) / 255.0
-            )
+            if args.augment:
+                # the host augmentation's in-graph twin (crop+flip then
+                # the dataset-stats normalize), applied after the HBM
+                # gather — augmented device-cached training
+                from tpudist.data.transforms import (
+                    _STATS, device_compose, device_normalize,
+                    device_random_crop_flip,
+                )
+
+                mean, std = _STATS[args.dataset]
+                input_transform = loader.input_transform(
+                    device_compose(
+                        device_random_crop_flip(),
+                        device_normalize(mean, std, dtype=dtype),
+                    )
+                )
+            else:
+                # in-graph ToTensor (uint8 → [0,1] float), the reference's
+                # transform (main.py:46) moved into the compiled step
+                input_transform = loader.input_transform(
+                    lambda x: x.astype(dtype) / 255.0
+                )
         elif args.augment:
             from tpudist.data.transforms import standard_cifar_augment
 
@@ -229,6 +308,7 @@ def main(argv=None):
     tx = make_optimizer(
         lr, optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
+        skip_nonfinite_updates=args.amp,
     )
     if args.label_smoothing:
         from tpudist.train import smoothed_cross_entropy
@@ -253,13 +333,45 @@ def main(argv=None):
         resume=not args.no_resume,
     )
 
+    if args.amp and ctx.process_index == 0:
+        from tpudist.amp import skipped_steps
+
+        skipped = skipped_steps(state.opt_state)
+        if skipped:
+            print(f"amp: skipped {skipped} non-finite update step(s)")
+
     if args.eval:
         from tpudist.train import evaluate
 
         # the reference's val loader is unsharded (every rank sees the full
         # set, /root/reference/main.py:56-63); same here, and only rank 0
         # reports — matching the commented-out accuracy print (main.py:129)
-        if args.dataset == "imagenet":
+        eval_input_transform = None
+        if args.dataset == "imagenet" and args.packed_val:
+            from tpudist.data.packed import load_packed
+            from tpudist.data.transforms import (
+                IMAGENET_MEAN, IMAGENET_STD, device_normalize,
+            )
+
+            vdata = load_packed(args.packed_val)
+            if vdata["classes"] != train_classes:
+                # same label-stability contract as the streaming val path
+                # below: a val pack built without --classes_from (or from a
+                # tree missing a class dir) would silently shift labels
+                raise SystemExit(
+                    "--packed_val class list does not match the training "
+                    "classes — rebuild it with `python -m "
+                    "tpudist.data.packed --classes_from <train pack>`"
+                )
+            val_loader = DataLoader(
+                {"image": vdata["image"], "label": vdata["label"]},
+                per_process_batch, transform=None, drop_remainder=False,
+            )
+            # same in-graph normalize the training step used
+            eval_input_transform = device_normalize(
+                IMAGENET_MEAN, IMAGENET_STD, dtype=dtype
+            )
+        elif args.dataset == "imagenet":
             from tpudist.data.imagenet import ImageFolderLoader
 
             val_loader = ImageFolderLoader(
@@ -268,7 +380,7 @@ def main(argv=None):
                 workers=args.workers, drop_remainder=False,
                 # train's class list keys the labels: a val tree missing a
                 # class dir can't silently shift every later label
-                classes=loader.classes,
+                classes=train_classes,
             )
         else:
             if args.dataset == "synthetic":
@@ -293,7 +405,10 @@ def main(argv=None):
             val_loader = DataLoader(
                 val, eval_batch, transform=eval_transform, drop_remainder=False
             )
-        acc = evaluate(model, state, val_loader, mesh)
+        acc = evaluate(
+            model, state, val_loader, mesh,
+            input_transform=eval_input_transform,
+        )
         if ctx.process_index == 0:
             print(f"Accuracy: {acc:.4f}")
     return state, losses

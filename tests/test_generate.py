@@ -104,6 +104,39 @@ def test_sample_logits_modes():
     assert wide.shape == (3,)
 
 
+def test_sample_logits_top_p_nucleus():
+    # probs [0.5, 0.3, 0.15, 0.05] (descending by construction): top_p=0.7
+    # keeps the smallest prefix covering >= 0.7 → tokens {0, 1}
+    probs = np.array([0.5, 0.3, 0.15, 0.05])
+    logits = jnp.asarray(np.log(probs))[None, :]
+    draws = {
+        int(sample_logits(logits, jax.random.key(i), top_p=0.7)[0])
+        for i in range(40)
+    }
+    assert draws <= {0, 1} and len(draws) == 2
+    # a tiny p still keeps the single most likely token (never empties)
+    only_top = {
+        int(sample_logits(logits, jax.random.key(i), top_p=1e-6)[0])
+        for i in range(10)
+    }
+    assert only_top == {0}
+    # p=1.0 is a no-op: every token reachable at high temperature
+    all_tok = {
+        int(sample_logits(logits, jax.random.key(i), temperature=5.0,
+                          top_p=1.0)[0])
+        for i in range(200)
+    }
+    assert all_tok == {0, 1, 2, 3}
+    # composes with top_k (HF order): k=3 renormalizes to
+    # [0.526, 0.316, 0.158], so p=0.8 keeps the first two (exclusive
+    # cumulative 0.842 >= 0.8 drops token 2)
+    combo = {
+        int(sample_logits(logits, jax.random.key(i), top_k=3, top_p=0.8)[0])
+        for i in range(40)
+    }
+    assert combo <= {0, 1}
+
+
 def test_generate_with_tensor_sharded_params():
     """Decode composes with tensor parallelism: Megatron-sharded params on
     a data x tensor mesh generate the same tokens as replicated params."""

@@ -1,0 +1,113 @@
+"""Packed (pre-decoded) image datasets — tpudist.data.packed.
+
+The pack is the framework's answer to decode-bound streaming input
+(SURVEY.md §7 hard-part #1 at BASELINE configs 2/3 scale): these tests pin
+the one-time pack's bit-parity with the streaming eval loader, the memmap
+round-trip, and that the packed dict drops into the existing array
+pipeline (DataLoader gather, DeviceCachedLoader in-graph gather, fit).
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.data.packed import load_packed, pack_image_folder
+
+
+@pytest.fixture(scope="module")
+def jpeg_tree(tmp_path_factory):
+    """Tiny class-separable JPEG tree: 2 classes x 6 images, varied source
+    sizes (the pack must resize/crop them to one shape)."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    for ci, cls in enumerate(["cat", "dog"]):
+        (root / cls).mkdir()
+        for i in range(6):
+            w, h = int(rng.integers(36, 64)), int(rng.integers(36, 64))
+            base = np.full((h, w, 3), 40 + 160 * ci, np.uint8)
+            noise = rng.integers(0, 40, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(base + noise).save(root / cls / f"{i}.jpg")
+    return root
+
+
+def test_pack_roundtrip(jpeg_tree, tmp_path):
+    out = pack_image_folder(jpeg_tree, tmp_path / "p", image_size=24)
+    assert out["n"] == 12 and out["images_per_sec"] > 0
+    data = load_packed(tmp_path / "p")
+    assert data["image"].shape == (12, 24, 24, 3)
+    assert data["image"].dtype == np.uint8
+    assert data["classes"] == ["cat", "dog"]
+    np.testing.assert_array_equal(data["label"], [0] * 6 + [1] * 6)
+    # memmap'd by default: pages fault in on demand
+    assert isinstance(data["image"], np.memmap)
+
+
+def test_pack_pixels_match_streaming_eval_loader(jpeg_tree, tmp_path):
+    """Bit-parity with ImageFolderLoader(train=False): the pack is the eval
+    transform applied once, not a different resample."""
+    from tpudist.data.imagenet import ImageFolderLoader
+
+    pack_image_folder(jpeg_tree, tmp_path / "p", image_size=24)
+    packed = load_packed(tmp_path / "p")
+    with ImageFolderLoader(
+        jpeg_tree, 12, train=False, image_size=24, normalize=False,
+        drop_remainder=False,
+    ) as loader:
+        batch = next(iter(loader))
+    np.testing.assert_array_equal(np.asarray(packed["image"]), batch["image"])
+    np.testing.assert_array_equal(packed["label"], batch["label"])
+
+
+def test_val_pack_keyed_by_train_classes(jpeg_tree, tmp_path):
+    """A val tree missing a class dir must keep the train label space
+    (scan_image_folder's contract, carried through the pack CLI path)."""
+    import shutil
+
+    val_root = tmp_path / "val"
+    shutil.copytree(jpeg_tree, val_root)
+    shutil.rmtree(val_root / "cat")
+    pack_image_folder(
+        val_root, tmp_path / "v", image_size=24, classes=["cat", "dog"]
+    )
+    data = load_packed(tmp_path / "v")
+    np.testing.assert_array_equal(data["label"], [1] * 6)  # dog stays 1
+    with open(str(tmp_path / "v") + "_meta.json") as f:
+        assert json.load(f)["classes"] == ["cat", "dog"]
+
+
+def test_packed_streams_through_dataloader_and_device_cache(jpeg_tree, tmp_path):
+    """The packed dict IS an array dataset: DataLoader gathers from the
+    memmap, DeviceCachedLoader stages it to the (fake) device mesh and the
+    in-graph gather reproduces the same pixels."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.data.device_cache import DeviceCachedLoader
+    from tpudist.data.loader import DataLoader
+    from tpudist.data.sampler import DistributedSampler
+
+    pack_image_folder(jpeg_tree, tmp_path / "p", image_size=24)
+    data = load_packed(tmp_path / "p")
+    dataset = {"image": data["image"], "label": data["label"]}
+
+    sampler = DistributedSampler(12, num_replicas=1, rank=0, shuffle=True)
+    host = next(iter(DataLoader(dataset, 8, sampler=sampler, transform=None)))
+    assert host["image"].dtype == np.uint8 and host["image"].shape == (8, 24, 24, 3)
+
+    mesh = mesh_lib.create_mesh()
+    cached = DeviceCachedLoader(dataset, 8, mesh=mesh, sampler=sampler)
+    batch = next(iter(cached))
+    gathered = np.asarray(
+        jnp.take(batch["_cache"], jnp.asarray(batch["image"]), axis=0)
+    )
+    np.testing.assert_array_equal(gathered, host["image"])
+    np.testing.assert_array_equal(batch["label"], host["label"])
+
+
+def test_pack_refuses_inconsistent_files(jpeg_tree, tmp_path):
+    pack_image_folder(jpeg_tree, tmp_path / "p", image_size=24)
+    np.save(str(tmp_path / "p") + "_labels.npy", np.zeros(3, np.int32))
+    with pytest.raises(ValueError, match="inconsistent"):
+        load_packed(tmp_path / "p")

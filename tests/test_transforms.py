@@ -129,3 +129,79 @@ def test_trains_on_uint8_batches_with_device_transform():
         assert batch["image"].dtype == np.uint8
         state, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+def test_device_random_crop_flip_step_keyed():
+    """In-graph augmentation: uint8-preserving, deterministic per step,
+    fresh across steps, identity population per row (crop+flip only move
+    pixels)."""
+    import jax.numpy as jnp
+
+    from tpudist.data.transforms import device_random_crop_flip
+
+    aug = device_random_crop_flip(pad=2, seed=0)
+    assert aug.wants_step
+    x = jnp.asarray(_batch(8)["image"])
+    a1 = np.asarray(aug(x, 3))
+    a2 = np.asarray(aug(x, 3))
+    a3 = np.asarray(aug(x, 4))
+    assert a1.dtype == np.uint8 and a1.shape == x.shape
+    np.testing.assert_array_equal(a1, a2)  # same step -> same crops
+    assert (a1 != a3).any()  # different step -> different crops
+
+
+def test_device_compose_propagates_wants_step():
+    from tpudist.data.transforms import (
+        device_compose, device_normalize, device_random_crop_flip,
+    )
+
+    plain = device_compose(device_normalize(CIFAR10_MEAN, CIFAR10_STD))
+    assert not plain.wants_step
+    chain = device_compose(
+        device_random_crop_flip(pad=2),
+        device_normalize(CIFAR10_MEAN, CIFAR10_STD),
+    )
+    assert chain.wants_step
+    import jax.numpy as jnp
+
+    x = jnp.asarray(_batch(4)["image"])
+    out = chain(x, 0)
+    assert out.dtype == jnp.float32 and out.shape == x.shape
+
+
+def test_augmented_device_cache_trains_and_eval_refuses_augment():
+    """DeviceCachedLoader + in-graph crop/flip/normalize trains (fresh
+    crops each step via the step key), and the eval path REFUSES a
+    wants_step transform instead of silently scoring augmented inputs."""
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.data.device_cache import DeviceCachedLoader
+    from tpudist.data.transforms import (
+        device_compose, device_normalize, device_random_crop_flip,
+    )
+    from tpudist.models import resnet18
+    from tpudist.train import create_train_state, evaluate, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    data = _batch(32)
+    cached = DeviceCachedLoader(data, 16, mesh=mesh)
+    transform = cached.input_transform(
+        device_compose(
+            device_random_crop_flip(),
+            device_normalize(CIFAR10_MEAN, CIFAR10_STD),
+        )
+    )
+    assert transform.wants_step and transform.wants_batch
+    model = resnet18(num_classes=10, small_inputs=True)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
+    step = make_train_step(model, tx, mesh, input_transform=transform)
+    for batch in cached:
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    with pytest.raises(ValueError, match="wants_step"):
+        evaluate(model, state, cached, mesh, input_transform=transform)

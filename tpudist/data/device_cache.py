@@ -123,8 +123,11 @@ class DeviceCachedLoader:
     def input_transform(self, post=None):
         """The in-graph ``indices → images`` gather to pass as
         ``make_train_step(input_transform=...)``; ``post`` (e.g.
-        :func:`tpudist.data.transforms.device_normalize`) is applied to the
-        gathered batch inside the same program.
+        :func:`tpudist.data.transforms.device_normalize`, or a
+        ``device_compose`` chain with in-graph augmentation) is applied to
+        the gathered batch inside the same program. A ``post`` declaring
+        ``wants_step`` propagates: the composite receives the step counter
+        and hands it through (the augmentation-randomness contract).
 
         The cache array reaches the compiled program as a REAL argument —
         every batch this loader yields carries it under ``"_cache"`` and the
@@ -133,12 +136,16 @@ class DeviceCachedLoader:
         whole dataset as an HLO literal: measured as a multi-minute compile
         stall on a remote-compile attach (the literal ships with the HLO
         over the degraded tunnel) and a duplicated copy in device memory."""
+        post_wants_step = getattr(post, "wants_step", False)
 
-        def run(indices, batch):
+        def run(indices, batch, step=None):
             gathered = jnp.take(batch["_cache"], indices, axis=0)
-            return post(gathered) if post is not None else gathered
+            if post is None:
+                return gathered
+            return post(gathered, step) if post_wants_step else post(gathered)
 
         run.wants_batch = True
+        run.wants_step = post_wants_step
         return run
 
     def _index_batches(self):

@@ -144,6 +144,59 @@ def device_normalize(mean, std, dtype=None):
     return run
 
 
+def device_random_crop_flip(pad: int = 4, flip: bool = True, *, seed: int = 0):
+    """IN-GRAPH train augmentation — the device twin of
+    :func:`random_crop_flip` for batches that never touch the host
+    (DeviceCachedLoader gathers, packed memmap batches staged raw).
+
+    Declares ``wants_step``: randomness is keyed by ``(seed, step)`` via
+    ``fold_in`` — deterministic, identical across replicas/processes (the
+    compiled program is SPMD over the global batch), fresh every step and
+    every grad-accumulation microbatch. Reflect-pad + per-sample random
+    crop + random horizontal flip, all fused by XLA into the surrounding
+    gather/normalize.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run(x, step):
+        b, h, w, _ = x.shape
+        key = jax.random.fold_in(jax.random.key(seed), step)
+        ky, kx, kf = jax.random.split(key, 3)
+        padded = jnp.pad(
+            x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+        )
+        ys = jax.random.randint(ky, (b,), 0, 2 * pad + 1)
+        xs = jax.random.randint(kx, (b,), 0, 2 * pad + 1)
+        rows = ys[:, None] + jnp.arange(h)[None, :]
+        cols = xs[:, None] + jnp.arange(w)[None, :]
+        out = padded[
+            jnp.arange(b)[:, None, None], rows[:, :, None], cols[:, None, :]
+        ]
+        if flip:
+            do = jax.random.bernoulli(kf, 0.5, (b,))
+            out = jnp.where(do[:, None, None, None], out[:, :, ::-1, :], out)
+        return out
+
+    run.wants_step = True
+    return run
+
+
+def device_compose(*fns):
+    """Compose in-graph transforms (for ``make_train_step``'s
+    ``input_transform`` / ``DeviceCachedLoader.input_transform``'s
+    ``post``); the composite declares ``wants_step`` iff any part does,
+    and the step reaches exactly the parts that asked for it."""
+
+    def run(x, step=None):
+        for f in fns:
+            x = f(x, step) if getattr(f, "wants_step", False) else f(x)
+        return x
+
+    run.wants_step = any(getattr(f, "wants_step", False) for f in fns)
+    return run
+
+
 def standard_cifar_augment(seed: int = 0, dataset: str = "cifar10"):
     """crop(pad 4) + flip → fused ToTensor+normalize — the standard CIFAR
     training pipeline (the reference's is ToTensor only), with the named

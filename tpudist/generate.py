@@ -19,9 +19,12 @@ import numpy as np
 
 
 def sample_logits(logits, rng, *, temperature: float = 1.0,
-                  top_k: int | None = None):
+                  top_k: int | None = None, top_p: float | None = None):
     """One sampling step over ``[B, V]`` logits. ``temperature=0`` is
-    greedy; ``top_k`` keeps only the k most likely tokens."""
+    greedy; ``top_k`` keeps only the k most likely tokens; ``top_p`` keeps
+    the smallest set of tokens whose probabilities sum to >= p (nucleus
+    sampling). Filters compose in the HF order: temperature → top_k →
+    top_p."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -29,6 +32,18 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
         k = min(top_k, logits.shape[-1])  # clamp like HF/torch samplers
         kth = jax.lax.top_k(logits, k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        # nucleus: sort descending, keep tokens whose EXCLUSIVE cumulative
+        # probability is < p (the most likely token always survives), drop
+        # the rest by thresholding at the last kept token's logit
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive_cum < top_p
+        thresh = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -40,13 +55,15 @@ def generate(
     *,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     seed: int = 0,
 ) -> np.ndarray:
     """Continue ``prompt`` (``[B, P]`` int tokens) by ``max_new_tokens``.
 
     Works for any model with the decode contract (``decode=True`` +
     ``cache`` collection): GPT-2 and Llama. Returns ``[B, max_new_tokens]``
-    int32. Greedy when ``temperature=0``, else temperature/top-k sampling.
+    int32. Greedy when ``temperature=0``, else temperature/top-k/top-p
+    (nucleus) sampling.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
@@ -70,6 +87,7 @@ def generate(
     out = _run(
         model, params, cache, prompt, jax.random.key(seed),
         max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k,
+        top_p=top_p,
     )
     if not out.is_fully_addressable:
         # multi-process with sharded/global params: the jit output may span
@@ -86,10 +104,11 @@ def generate(
 
 @partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k"),
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "top_p"),
 )
 def _run(model, params, cache, prompt, rng, *, max_new_tokens, temperature,
-         top_k):
+         top_k, top_p):
     """One compiled program for prefill + sampling. ``params`` is a traced
     argument (not a closure constant), and jit caches on the static
     (model, length, sampling) config — repeated generate() calls with the
@@ -110,7 +129,8 @@ def _run(model, params, cache, prompt, rng, *, max_new_tokens, temperature,
         cache, last_logits, rng = carry
         rng, sub = jax.random.split(rng)
         tok = sample_logits(
-            last_logits, sub, temperature=temperature, top_k=top_k
+            last_logits, sub, temperature=temperature, top_k=top_k,
+            top_p=top_p,
         )
         cache, next_logits = decode_step(cache, tok)
         return (cache, next_logits, rng), tok

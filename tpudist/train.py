@@ -136,7 +136,7 @@ def state_shardings_of(state: TrainState):
 
 
 
-def _apply_input_transform(transform, inputs, batch):
+def _apply_input_transform(transform, inputs, batch, step=None):
     """The one home for the input_transform calling convention: plain
     transforms receive the inputs; transforms declaring ``wants_batch``
     also receive the whole batch dict — the hook for device-resident
@@ -144,12 +144,29 @@ def _apply_input_transform(transform, inputs, batch):
     jit arguments. A closure-captured jax.Array would be lowered as an HLO
     literal, and on a remote-compile attach a literal the size of a dataset
     ships with the HLO over the (slow) tunnel — a measured multi-minute
-    stall per compile."""
+    stall per compile.
+
+    Transforms declaring ``wants_step`` additionally receive the step
+    counter (last positional arg) — the randomness key for in-graph
+    augmentation (``tpudist.data.transforms.device_random_crop_flip``).
+    Eval paths pass ``step=None`` and refuse such transforms: augmentation
+    has no business in an eval pass, and scoring through one silently
+    would corrupt the measurement."""
     if transform is None:
         return inputs
+    wants_step = getattr(transform, "wants_step", False)
+    if wants_step and step is None:
+        raise ValueError(
+            "input_transform declares wants_step (an augmenting transform) "
+            "but this is an eval path — evaluate with the normalization "
+            "transform only"
+        )
+    args = [inputs]
     if getattr(transform, "wants_batch", False):
-        return transform(inputs, batch)
-    return transform(inputs)
+        args.append(batch)
+    if wants_step:
+        args.append(step)
+    return transform(*args)
 
 
 def make_train_step(
@@ -220,7 +237,9 @@ def make_train_step(
     def forward(params, batch_stats, batch, step):
         variables = {"params": params, "batch_stats": batch_stats}
         has_stats = len(batch_stats) > 0
-        inputs = _apply_input_transform(input_transform, batch[input_key], batch)
+        inputs = _apply_input_transform(
+            input_transform, batch[input_key], batch, step
+        )
         mutable = (["batch_stats"] if has_stats else []) + (
             ["losses"] if wants_aux else []
         )
