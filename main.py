@@ -73,6 +73,12 @@ def parse_args(argv=None):
     parser.add_argument("--packed_val", default=None, type=str,
                         help="pack prefix for the val split (with --eval); "
                         "defaults to the image-folder val/ tree")
+    parser.add_argument("--cache_shard_rows", default=0, type=int,
+                        help="with --packed --device_cache: rotate the HBM "
+                        "cache in shards of this many rows (for packs "
+                        "larger than HBM; shard k+1 stages while shard k "
+                        "trains — tpudist.data.device_cache."
+                        "RotatingDeviceCache). 0 = fully resident")
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     parser.add_argument("--amp", action="store_true",
                         help="mixed precision END-TO-END (tpudist.amp): the "
@@ -192,6 +198,12 @@ def main(argv=None):
             len(pdata["label"]), num_replicas=ctx.process_count,
             rank=ctx.process_index,
         )
+        if args.cache_shard_rows and not args.device_cache:
+            raise SystemExit(
+                "--cache_shard_rows rotates the HBM cache and needs "
+                "--device_cache; without it training would silently run "
+                "the host-streaming path"
+            )
         norm = device_normalize(IMAGENET_MEAN, IMAGENET_STD, dtype=dtype)
         if args.augment:
             # packed pixels are the deterministic eval decode; --augment
@@ -206,7 +218,17 @@ def main(argv=None):
                 device_random_crop_flip(pad=max(args.image_size // 28, 4)),
                 norm,
             )
-        if args.device_cache:
+        if args.device_cache and args.cache_shard_rows:
+            from tpudist.data.device_cache import RotatingDeviceCache
+
+            # pack larger than HBM: double-buffered shard rotation —
+            # windowed shuffle, every row once per epoch
+            loader = RotatingDeviceCache(
+                pdata, per_process_batch, mesh=mesh,
+                shard_rows=args.cache_shard_rows,
+            )
+            input_transform = loader.input_transform(norm)
+        elif args.device_cache:
             from tpudist.data.device_cache import DeviceCachedLoader
 
             # staged pre-compile (same contract as the CIFAR path below)

@@ -41,6 +41,22 @@ from tpudist import mesh as mesh_lib
 from tpudist.data.sampler import DistributedSampler
 
 
+def _chunked_device_put(images: np.ndarray, sharding) -> jax.Array:
+    """One H2D of a large array in ~64 MB slices, reassembled on device: a
+    single hundreds-of-MB ``device_put`` has been observed to hang a
+    remote-attach transport outright, and chunking costs nothing on a
+    local DMA path."""
+    row_bytes = max(images[:1].nbytes, 1)
+    rows_per_chunk = max(64 * 1024 * 1024 // row_bytes, 1)
+    if images.shape[0] <= rows_per_chunk:
+        return jax.device_put(images, sharding)
+    pieces = [
+        jax.device_put(images[lo: lo + rows_per_chunk], sharding)
+        for lo in range(0, images.shape[0], rows_per_chunk)
+    ]
+    return jnp.concatenate(pieces, axis=0)
+
+
 class DeviceCachedLoader:
     """Iterable of index batches over an HBM-cached dataset.
 
@@ -86,22 +102,11 @@ class DeviceCachedLoader:
         # ONE H2D of the full set, replicated over the mesh. Done eagerly at
         # construction — build the loader BEFORE the first compiled program
         # (e.g. before create_train_state) to get the fast pre-compile link
-        # on remote attaches. The transfer is CHUNKED (~64 MB slices,
-        # reassembled on device): a single hundreds-of-MB device_put has
-        # been observed to hang a remote-attach transport outright, and
-        # chunking costs nothing on a local DMA path.
-        sharding = mesh_lib.replicated_sharding(self.mesh)
-        row_bytes = max(images[:1].nbytes, 1)
-        rows_per_chunk = max(64 * 1024 * 1024 // row_bytes, 1)
-        if images.shape[0] <= rows_per_chunk:
-            self._cache = jax.device_put(images, sharding)
-        else:
-            pieces = [
-                jax.device_put(images[lo : lo + rows_per_chunk], sharding)
-                for lo in range(0, images.shape[0], rows_per_chunk)
-            ]
-            self._cache = jnp.concatenate(pieces, axis=0)
-            del pieces
+        # on remote attaches. Chunked via _chunked_device_put (transport-
+        # hang guard).
+        self._cache = _chunked_device_put(
+            images, mesh_lib.replicated_sharding(self.mesh)
+        )
         self._img_shape = images.shape[1:]
 
     def __len__(self) -> int:
@@ -174,3 +179,177 @@ class DeviceCachedLoader:
     def __iter__(self):
         for idx in self._index_batches():
             yield self._make_batch(idx)
+
+
+class RotatingDeviceCache:
+    """Device cache for datasets LARGER than HBM: the set is split into
+    row-shards, and while the step consumes shard ``k`` from HBM, shard
+    ``k+1`` stages in the background (host memmap read + chunked H2D on a
+    staging thread), so the per-step path stays index-only. HBM residency:
+    two shards held by the loader, and the consumer's in-flight batch can
+    transiently pin a third around a shard transition — size
+    ``shard_rows`` for at most THREE shard buffers against free HBM.
+
+    This is the streaming complement to :class:`DeviceCachedLoader`
+    (docs/PERF.md §3c): a packed ImageNet-1k at 224² is ~193 GB against
+    16 GB HBM, but a 2–4 GB shard stages in well under the time the chip
+    spends training through the previous one (shard of R rows buys
+    ``R/rate`` seconds of compute against ``R·row_bytes/bandwidth``
+    seconds of transfer — at 2,570 img/s and 150 KB/row, any link above
+    ~385 MB/s keeps the rotation ahead, the same §3 requirement as direct
+    streaming, but paid OFF the critical path and with in-graph
+    gather/augment/normalize like the resident cache).
+
+    Shuffle semantics, stated plainly: rotation trades the sampler's
+    GLOBAL per-epoch permutation for the standard windowed approximation —
+    shard ORDER is permuted per epoch and rows shuffle WITHIN the resident
+    shard (window = shard_rows, vastly larger than typical shuffle-buffer
+    windows). Every row is still visited exactly once per epoch, and the
+    (seed, epoch) keying keeps it deterministic and resumable. Recipes
+    that need the exact global permutation use the host loaders or the
+    fully-resident cache.
+
+    Works straight off a :func:`tpudist.data.packed.load_packed` memmap:
+    each shard's rows are materialized host-side only transiently for the
+    H2D copy.
+
+    Multi-process: the (seed, epoch) plan is global and identical on every
+    process, each process stages the SAME shard pixels (the cache operand
+    is replicated, like :class:`DeviceCachedLoader`'s), and per batch each
+    process contributes its rank's stride of the global within-shard
+    order — the DistributedSampler disjointness contract at the batch
+    level.
+    """
+
+    def __init__(
+        self,
+        dataset: Mapping[str, np.ndarray],
+        batch_size: int,
+        *,
+        shard_rows: int,
+        mesh=None,
+        input_key: str = "image",
+        label_key: str = "label",
+        seed: int = 0,
+        rank: int | None = None,
+        num_replicas: int | None = None,
+    ):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
+        self.batch_size = batch_size  # per-process rows per step
+        self.input_key = input_key
+        self.label_key = label_key
+        self.seed = seed
+        self._images = dataset[input_key]  # memmap-friendly: sliced per shard
+        self._labels = np.ascontiguousarray(dataset[label_key])
+        self._n = self._images.shape[0]
+        self._rank = rank if rank is not None else jax.process_index()
+        self._world = (
+            num_replicas if num_replicas is not None else jax.process_count()
+        )
+        self._global_batch = batch_size * self._world
+        shard_rows = min(shard_rows, self._n)
+        if shard_rows % self._global_batch:
+            raise ValueError(
+                f"shard_rows {shard_rows} must divide by the global batch "
+                f"{self._global_batch} (a batch never spans two resident "
+                "shards)"
+            )
+        self.shard_rows = shard_rows
+        self.epoch = 0
+        # fit() drives per-epoch reshuffle via loader.sampler.set_epoch();
+        # the rotation owns its epoch keying, so it is its own "sampler"
+        self.sampler = self
+        self._sharding = mesh_lib.replicated_sharding(self.mesh)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        # whole shards only, ragged tail shard dropped (static shapes: the
+        # compiled program sees ONE [shard_rows, ...] cache operand)
+        return (self._n // self.shard_rows) * (
+            self.shard_rows // self._global_batch
+        )
+
+    def probe(self) -> dict:
+        return {
+            self.input_key: np.zeros(
+                (1, *self._images.shape[1:]), np.float32
+            ),
+            self.label_key: self._labels[:1],
+        }
+
+    # same in-graph contract as DeviceCachedLoader (the "_cache" operand)
+    input_transform = DeviceCachedLoader.input_transform
+
+    def _epoch_plan(self):
+        """(shards, orders): global row ids per shard (sorted — sequential
+        memmap reads) and the within-shard shuffle, identical on every
+        process by (seed, epoch) construction."""
+        rng = np.random.Generator(np.random.PCG64(
+            np.random.SeedSequence([self.seed, self.epoch])
+        ))
+        order = rng.permutation(self._n)
+        n_shards = self._n // self.shard_rows
+        shards = [
+            np.sort(order[s * self.shard_rows:(s + 1) * self.shard_rows])
+            for s in range(n_shards)
+        ]
+        orders = [rng.permutation(self.shard_rows) for _ in range(n_shards)]
+        return shards, orders
+
+    def _stage(self, shard_global_rows: np.ndarray):
+        """Gather one shard's pixels from the (mem-mapped) source and put
+        them on device (chunked — transport-hang guard); runs on the
+        iterator's staging thread so BOTH the host read and the H2D are
+        off the training loop's critical path."""
+        pixels = np.ascontiguousarray(self._images[shard_global_rows])
+        return (
+            _chunked_device_put(pixels, self._sharding),
+            self._labels[shard_global_rows],
+        )
+
+    def iter_from(self, start_batch: int):
+        """Mid-epoch resume at the batch level (shards before the target
+        batch are skipped without staging)."""
+        per_shard = self.shard_rows // self._global_batch
+        first_shard = start_batch // per_shard
+        skip = start_batch - first_shard * per_shard
+        for i, batch in enumerate(self._iter_impl(first_shard)):
+            if i >= skip:
+                yield batch
+
+    def __iter__(self):
+        return self._iter_impl(0)
+
+    def _iter_impl(self, start_shard: int):
+        from concurrent.futures import ThreadPoolExecutor
+
+        shards, orders = self._epoch_plan()
+        shards, orders = shards[start_shard:], orders[start_shard:]
+        if not shards:
+            return
+        # one staging thread: the next shard's memmap gather AND its H2D
+        # both run there, overlapping the whole current shard's stepping
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            pending = pool.submit(self._stage, shards[0])
+            for s in range(len(shards)):
+                cache, labels = pending.result()
+                if s + 1 < len(shards):
+                    pending = pool.submit(self._stage, shards[s + 1])
+                order = orders[s]
+                for lo in range(0, self.shard_rows, self._global_batch):
+                    window = order[lo:lo + self._global_batch]
+                    # this process's stride of the global batch (disjoint
+                    # across ranks, union = the window)
+                    idx = window[self._rank::self._world]
+                    yield {
+                        self.input_key: np.ascontiguousarray(
+                            idx.astype(np.int32)
+                        ),
+                        self.label_key: np.ascontiguousarray(labels[idx]),
+                        "_cache": cache,
+                    }
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
