@@ -159,6 +159,7 @@ def main(argv=None):
 
     import jax
 
+    attn_requested = args.attn  # the user's words, pre-resolution
     if args.attn == "auto":
         # multi_head_attention(impl="auto") would route per-call; resolving
         # here keeps the choice visible in the run's config echo. Matches
@@ -234,12 +235,16 @@ def main(argv=None):
             # --pipe composes with data AND tensor parallelism (the pipeline
             # shard_map is manual over 'pipe' only; Megatron tensor shardings
             # ride the stacked params under GSPMD — tpudist.parallel.pp);
-            # MoE/context-parallel attention are not pipelined
-            if args.experts or args.attn in ("ring", "ulysses", "ulysses_flash"):
+            # MoE/context-parallel/kernel attention are not pipelined. An
+            # EXPLICIT kernel request errors; --attn auto quietly resolves
+            # to the supported XLA path inside the pipeline.
+            if args.experts or attn_requested not in ("xla", "auto"):
                 raise SystemExit(
-                    "--pipe composes with --tensor and data parallelism; "
-                    "MoE/context-parallel attention are not pipelined"
+                    "--pipe composes with --tensor and data parallelism and "
+                    "runs XLA attention; MoE/context-parallel/kernel "
+                    "attention are not pipelined"
                 )
+            args.attn = "xla"
             if args.dropout:
                 raise SystemExit("--dropout is not supported with --pipe")
             if args.arch != "gpt2":

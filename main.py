@@ -181,6 +181,16 @@ def main(argv=None):
     # assembles the global batch of batch_size × world_size
     per_process_batch = args.batch_size * jax.local_device_count()
     input_transform = None  # set by the --device_cache path
+    if args.cache_shard_rows and not (
+        args.dataset == "imagenet" and args.packed and args.device_cache
+    ):
+        # guard EVERY dataset path: the rotation only backs the packed HBM
+        # cache, and silently ignoring the flag would run a path with a
+        # completely different memory/throughput profile
+        raise SystemExit(
+            "--cache_shard_rows rotates the packed HBM cache and needs "
+            "--dataset imagenet --packed <prefix> --device_cache"
+        )
 
     if args.dataset == "imagenet" and args.packed:
         # pre-decoded pack (tpudist.data.packed): pixels stream from a uint8
@@ -198,12 +208,6 @@ def main(argv=None):
             len(pdata["label"]), num_replicas=ctx.process_count,
             rank=ctx.process_index,
         )
-        if args.cache_shard_rows and not args.device_cache:
-            raise SystemExit(
-                "--cache_shard_rows rotates the HBM cache and needs "
-                "--device_cache; without it training would silently run "
-                "the host-streaming path"
-            )
         norm = device_normalize(IMAGENET_MEAN, IMAGENET_STD, dtype=dtype)
         if args.augment:
             # packed pixels are the deterministic eval decode; --augment

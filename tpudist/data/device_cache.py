@@ -42,19 +42,32 @@ from tpudist.data.sampler import DistributedSampler
 
 
 def _chunked_device_put(images: np.ndarray, sharding) -> jax.Array:
-    """One H2D of a large array in ~64 MB slices, reassembled on device: a
-    single hundreds-of-MB ``device_put`` has been observed to hang a
-    remote-attach transport outright, and chunking costs nothing on a
-    local DMA path."""
+    """One H2D of a large array in ~64 MB slices, assembled IN PLACE on
+    device: a single hundreds-of-MB ``device_put`` has been observed to
+    hang a remote-attach transport outright, and chunking costs nothing on
+    a local DMA path. Assembly writes each staged slice into a donated
+    device buffer (``dynamic_update_slice`` with ``donate_argnums``), so
+    the device high-water mark is ONE full buffer plus one slice — a
+    ``concatenate`` of all pieces would transiently hold 2× the array."""
     row_bytes = max(images[:1].nbytes, 1)
     rows_per_chunk = max(64 * 1024 * 1024 // row_bytes, 1)
-    if images.shape[0] <= rows_per_chunk:
+    n = images.shape[0]
+    if n <= rows_per_chunk:
         return jax.device_put(images, sharding)
-    pieces = [
-        jax.device_put(images[lo: lo + rows_per_chunk], sharding)
-        for lo in range(0, images.shape[0], rows_per_chunk)
-    ]
-    return jnp.concatenate(pieces, axis=0)
+    buf = jax.jit(
+        lambda: jnp.zeros(images.shape, images.dtype), out_shardings=sharding
+    )()
+    write = jax.jit(
+        lambda b, piece, lo: jax.lax.dynamic_update_slice(
+            b, piece, (lo,) + (0,) * (b.ndim - 1)
+        ),
+        donate_argnums=0,
+        out_shardings=sharding,
+    )
+    for lo in range(0, n, rows_per_chunk):
+        piece = jax.device_put(images[lo: lo + rows_per_chunk], sharding)
+        buf = write(buf, piece, lo)
+    return buf
 
 
 class DeviceCachedLoader:
@@ -322,34 +335,55 @@ class RotatingDeviceCache:
     def __iter__(self):
         return self._iter_impl(0)
 
-    def _iter_impl(self, start_shard: int):
-        from concurrent.futures import ThreadPoolExecutor
+    def _stage_async(self, shard_global_rows: np.ndarray):
+        """Run :meth:`_stage` on a DAEMON thread (a ThreadPoolExecutor's
+        non-daemon worker would be joined at interpreter exit — a stage
+        in flight over a wedged attach would then hang process shutdown
+        instead of letting the original error kill the run); returns a
+        one-slot queue carrying (ok, value_or_exception)."""
+        import queue
+        import threading
 
+        out: queue.Queue = queue.Queue(1)
+
+        def work():
+            try:
+                out.put((True, self._stage(shard_global_rows)))
+            except BaseException as e:  # surfaced at .get() in the iterator
+                out.put((False, e))
+
+        threading.Thread(target=work, daemon=True).start()
+        return out
+
+    @staticmethod
+    def _resolve(pending):
+        ok, value = pending.get()
+        if not ok:
+            raise value
+        return value
+
+    def _iter_impl(self, start_shard: int):
         shards, orders = self._epoch_plan()
         shards, orders = shards[start_shard:], orders[start_shard:]
         if not shards:
             return
-        # one staging thread: the next shard's memmap gather AND its H2D
-        # both run there, overlapping the whole current shard's stepping
-        pool = ThreadPoolExecutor(max_workers=1)
-        try:
-            pending = pool.submit(self._stage, shards[0])
-            for s in range(len(shards)):
-                cache, labels = pending.result()
-                if s + 1 < len(shards):
-                    pending = pool.submit(self._stage, shards[s + 1])
-                order = orders[s]
-                for lo in range(0, self.shard_rows, self._global_batch):
-                    window = order[lo:lo + self._global_batch]
-                    # this process's stride of the global batch (disjoint
-                    # across ranks, union = the window)
-                    idx = window[self._rank::self._world]
-                    yield {
-                        self.input_key: np.ascontiguousarray(
-                            idx.astype(np.int32)
-                        ),
-                        self.label_key: np.ascontiguousarray(labels[idx]),
-                        "_cache": cache,
-                    }
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+        # staging thread: the next shard's memmap gather AND its H2D both
+        # run there, overlapping the whole current shard's stepping
+        pending = self._stage_async(shards[0])
+        for s in range(len(shards)):
+            cache, labels = self._resolve(pending)
+            if s + 1 < len(shards):
+                pending = self._stage_async(shards[s + 1])
+            order = orders[s]
+            for lo in range(0, self.shard_rows, self._global_batch):
+                window = order[lo:lo + self._global_batch]
+                # this process's stride of the global batch (disjoint
+                # across ranks, union = the window)
+                idx = window[self._rank::self._world]
+                yield {
+                    self.input_key: np.ascontiguousarray(
+                        idx.astype(np.int32)
+                    ),
+                    self.label_key: np.ascontiguousarray(labels[idx]),
+                    "_cache": cache,
+                }

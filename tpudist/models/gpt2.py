@@ -376,6 +376,15 @@ class PipelinedGPT2:
             raise ValueError(
                 f"depth {depth} not divisible by pipe={mesh.shape[PIPELINE_AXIS]}"
             )
+        if attn_impl != "xla":
+            # pallas_call inside the pipe-manual shard_map region trips the
+            # varying-manual-axes checks in the kernels' interpret/backward
+            # scans — refuse loudly rather than fail with a cryptic trace
+            raise ValueError(
+                f"attn_impl={attn_impl!r} does not compose with the GPipe "
+                "schedule yet; the pipelined model runs XLA attention "
+                "(attn_impl='xla')"
+            )
         self.mesh = mesh
         self.num_micro = num_micro
         self.vocab_size = vocab_size

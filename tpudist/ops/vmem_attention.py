@@ -183,6 +183,18 @@ def _head_group(h: int, s_pad: int) -> int:
     return 1
 
 
+def _struct(shape, dtype, like):
+    """``ShapeDtypeStruct`` carrying ``like``'s varying-manual-axes type:
+    inside a partial-manual ``shard_map`` (e.g. the GPipe schedule's
+    pipe-manual region, tpudist.parallel.pp) every pallas output must
+    declare how it varies over the manual axes or the shard_map's vma
+    check rejects the call."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _spec(g, s, d):
     return pl.BlockSpec((1, g, s, d), lambda b, hg: (b, hg, 0, 0))
 
@@ -226,8 +238,8 @@ def _vmem_fwd_raw(q, k, v, *, causal, sm_scale, kv_len):
         in_specs=[_spec(g, s_q, d), kv_spec, kv_spec],
         out_specs=[_spec(g, s_q, d), _spec(g, s_q, 1)],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_q, 1), jnp.float32),
+            _struct(q.shape, q.dtype, q),
+            _struct((b, h, s_q, 1), jnp.float32, q),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -262,9 +274,9 @@ def _vmem_vjp_bwd(causal, sm_scale, kv_len, res, g):
                   _spec(grp, s_q, d), _spec(grp, s_q, d), _spec(grp, s_q, 1)],
         out_specs=[_spec(grp, s_q, d), kv_spec, kv_spec],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct(k.shape, kv_grad_dtype),
-            jax.ShapeDtypeStruct(v.shape, kv_grad_dtype),
+            _struct(q.shape, q.dtype, q),
+            _struct(k.shape, kv_grad_dtype, k),
+            _struct(v.shape, kv_grad_dtype, v),
         ],
         interpret=_interpret(),
     )(q, k, v, o, g, lse)
