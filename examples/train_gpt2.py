@@ -165,8 +165,10 @@ def main(argv=None):
         # here keeps the choice visible in the run's config echo. Matches
         # attention.py's measured crossover (vmem ≤ 1024, dense XLA in the
         # 1025–2047 window, flash from 2048). Off-TPU the Pallas kernels
-        # only run in interpret emulation, so CPU runs stay on XLA.
-        if jax.default_backend() != "tpu":
+        # only run in interpret emulation, so CPU runs stay on XLA; inside
+        # --pipe the kernels don't compose with the GPipe shard_map
+        # (build_model's guard), so auto resolves to XLA there too.
+        if args.pipe > 1 or jax.default_backend() != "tpu":
             args.attn = "xla"
         elif args.seq_len <= 1024:
             args.attn = "vmem"
@@ -244,7 +246,6 @@ def main(argv=None):
                     "runs XLA attention; MoE/context-parallel/kernel "
                     "attention are not pipelined"
                 )
-            args.attn = "xla"
             if args.dropout:
                 raise SystemExit("--dropout is not supported with --pipe")
             if args.arch != "gpt2":

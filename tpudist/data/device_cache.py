@@ -31,6 +31,7 @@ gather stays collective-free because the cache is replicated.
 
 from __future__ import annotations
 
+import functools
 from typing import Mapping
 
 import jax
@@ -41,22 +42,53 @@ from tpudist import mesh as mesh_lib
 from tpudist.data.sampler import DistributedSampler
 
 
-def _chunked_device_put(images: np.ndarray, sharding) -> jax.Array:
-    """One H2D of a large array in ~64 MB slices, assembled IN PLACE on
-    device: a single hundreds-of-MB ``device_put`` has been observed to
-    hang a remote-attach transport outright, and chunking costs nothing on
-    a local DMA path. Assembly writes each staged slice into a donated
-    device buffer (``dynamic_update_slice`` with ``donate_argnums``), so
-    the device high-water mark is ONE full buffer plus one slice — a
-    ``concatenate`` of all pieces would transiently hold 2× the array."""
+def _chunked_device_put(
+    images: np.ndarray, sharding, *, in_place: bool = False
+) -> jax.Array:
+    """One H2D of a large array in ~64 MB slices — a single
+    hundreds-of-MB ``device_put`` has been observed to hang a
+    remote-attach transport outright, and chunking costs nothing on a
+    local DMA path. Two assembly modes, each matched to WHEN it runs:
+
+    - default (``in_place=False``): all slices transfer FIRST, then one
+      ``concatenate`` compiles/executes. Transient device footprint is 2×
+      the array, but every byte rides the fast PRE-compile link — the
+      DeviceCachedLoader constructor's contract (docs/PERF.md §3b: the
+      degraded attach drops 60× after the first compiled program, and
+      measured: interleaving jitted writes with the transfer collapses
+      staging from ~1.5 GB/s to ~20 MB/s on that attach).
+    - ``in_place=True``: each slice is written into a DONATED device
+      buffer (``dynamic_update_slice``), high-water mark ONE buffer plus
+      one slice. For mid-training staging (RotatingDeviceCache), where
+      compiled programs have already run — the link is whatever it is —
+      and shard-sized HBM headroom is the scarce resource."""
     row_bytes = max(images[:1].nbytes, 1)
     rows_per_chunk = max(64 * 1024 * 1024 // row_bytes, 1)
     n = images.shape[0]
     if n <= rows_per_chunk:
         return jax.device_put(images, sharding)
-    buf = jax.jit(
-        lambda: jnp.zeros(images.shape, images.dtype), out_shardings=sharding
-    )()
+    if not in_place:
+        pieces = [
+            jax.device_put(images[lo: lo + rows_per_chunk], sharding)
+            for lo in range(0, n, rows_per_chunk)
+        ]
+        return jnp.concatenate(pieces, axis=0)
+    init, write = _assembly_fns(images.shape, images.dtype.str, sharding)
+    buf = init()
+    for lo in range(0, n, rows_per_chunk):
+        piece = jax.device_put(images[lo: lo + rows_per_chunk], sharding)
+        buf = write(buf, piece, lo)
+    return buf
+
+
+@functools.lru_cache(maxsize=16)
+def _assembly_fns(shape: tuple, dtype_str: str, sharding):
+    """Jitted (zeros-init, donated-write) pair for in-place assembly,
+    cached per (shape, dtype, sharding): jit's executable cache keys on
+    the function object, so fresh lambdas per shard would re-compile the
+    same two programs on every rotation (measured: 2 compiles per call)."""
+    dtype = np.dtype(dtype_str)
+    init = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
     write = jax.jit(
         lambda b, piece, lo: jax.lax.dynamic_update_slice(
             b, piece, (lo,) + (0,) * (b.ndim - 1)
@@ -64,10 +96,7 @@ def _chunked_device_put(images: np.ndarray, sharding) -> jax.Array:
         donate_argnums=0,
         out_shardings=sharding,
     )
-    for lo in range(0, n, rows_per_chunk):
-        piece = jax.device_put(images[lo: lo + rows_per_chunk], sharding)
-        buf = write(buf, piece, lo)
-    return buf
+    return init, write
 
 
 class DeviceCachedLoader:
@@ -318,7 +347,7 @@ class RotatingDeviceCache:
         off the training loop's critical path."""
         pixels = np.ascontiguousarray(self._images[shard_global_rows])
         return (
-            _chunked_device_put(pixels, self._sharding),
+            _chunked_device_put(pixels, self._sharding, in_place=True),
             self._labels[shard_global_rows],
         )
 
