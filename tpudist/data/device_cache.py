@@ -72,6 +72,11 @@ def _chunked_device_put(
             jax.device_put(images[lo: lo + rows_per_chunk], sharding)
             for lo in range(0, n, rows_per_chunk)
         ]
+        # enforce the documented order: device_put is async, so without
+        # this the concatenate (the process's first compiled program)
+        # would dispatch while slices are still streaming on the
+        # pre-compile link
+        jax.block_until_ready(pieces)
         return jnp.concatenate(pieces, axis=0)
     init, write = _assembly_fns(images.shape, images.dtype.str, sharding)
     buf = init()
@@ -111,6 +116,11 @@ class DeviceCachedLoader:
     sampler: optional pre-built DistributedSampler (defaults to a
         shuffle-on sampler over this process's rank).
     drop_remainder: drop the ragged tail (training default True).
+    stage_in_place: assemble the cache with the 1×-transient donated-buffer
+        mode instead of the default transfer-all-then-concatenate (which
+        transiently holds 2× the array). Turn on for datasets near HBM
+        capacity; costs the fast pre-compile link on degraded remote
+        attaches (see ``_chunked_device_put``).
     """
 
     def __init__(
@@ -124,6 +134,7 @@ class DeviceCachedLoader:
         label_key: str = "label",
         drop_remainder: bool = True,
         seed: int = 0,
+        stage_in_place: bool = False,
     ):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
         self.batch_size = batch_size
@@ -147,7 +158,8 @@ class DeviceCachedLoader:
         # on remote attaches. Chunked via _chunked_device_put (transport-
         # hang guard).
         self._cache = _chunked_device_put(
-            images, mesh_lib.replicated_sharding(self.mesh)
+            images, mesh_lib.replicated_sharding(self.mesh),
+            in_place=stage_in_place,
         )
         self._img_shape = images.shape[1:]
 
