@@ -1,0 +1,133 @@
+"""T5 encoder-decoder family (tpudist.models.t5): span corruption
+invariants, decoder causality, cross-attention liveness, and the compiled
+train step learning a deterministic denoising task."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpudist.models.t5 import (
+    T5, seq2seq_forward, span_corrupt_transform, span_corruption_plan,
+)
+
+_CFG = dict(vocab_size=64, hidden_dim=32, ffn_dim=64, enc_depth=2,
+            dec_depth=2, num_heads=4)
+
+
+def _toy_batch(b=4, length=32, vocab_floor=40, seed=0):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    # data ids stay below the sentinel/EOS range near vocab_size
+    return {"tokens": rng.integers(1, vocab_floor, (b, length)).astype(np.int32)}
+
+
+def test_span_corruption_shapes_and_reconstruction():
+    length = 32
+    noise, spans, enc_len, dec_len = span_corruption_plan(length)
+    t = span_corrupt_transform(64, seed=3)
+    batch = _toy_batch(length=length)
+    out = t(batch)
+    assert out["enc_tokens"].shape == (4, enc_len)
+    assert out["dec_tokens"].shape == (4, dec_len)
+    assert out["targets"].shape == (4, dec_len)
+    sentinels = 64 - 1 - np.arange(spans)
+    eos = 64 - spans - 1
+    for i in range(4):
+        enc, tgt, dec = out["enc_tokens"][i], out["targets"][i], out["dec_tokens"][i]
+        # every sentinel appears exactly once on each side, in order
+        assert [s for s in enc if s in sentinels] == list(sentinels)
+        assert [s for s in tgt if s in sentinels] == list(sentinels)
+        assert tgt[-1] == eos
+        # decoder input = target shifted right behind the start id
+        assert dec[0] == 0
+        np.testing.assert_array_equal(dec[1:], tgt[:-1])
+        # splicing the target's spans back into the encoder's gaps
+        # reconstructs the original window exactly
+        rebuilt = []
+        tpos = 0
+        for tok in enc:
+            if tok in sentinels:
+                tpos += 1  # skip the sentinel in the target stream
+                while tpos < len(tgt) and tgt[tpos] not in sentinels and tgt[tpos] != eos:
+                    rebuilt.append(int(tgt[tpos]))
+                    tpos += 1
+            else:
+                rebuilt.append(int(tok))
+        np.testing.assert_array_equal(rebuilt, batch["tokens"][i])
+
+
+def test_decoder_is_causal_and_uses_encoder():
+    model = T5(**_CFG)
+    rng = np.random.Generator(np.random.PCG64(0))
+    enc = jnp.asarray(rng.integers(1, 40, (2, 12)), jnp.int32)
+    dec = jnp.asarray(rng.integers(1, 40, (2, 8)), jnp.int32)
+    params = model.init(jax.random.key(0), enc, dec)
+    logits = model.apply(params, enc, dec, train=False)
+    assert logits.shape == (2, 8, 64) and logits.dtype == jnp.float32
+
+    # causality: perturbing a future decoder token leaves earlier logits
+    # bit-identical
+    dec2 = dec.at[:, 5].set((dec[:, 5] + 7) % 40)
+    logits2 = model.apply(params, enc, dec2, train=False)
+    np.testing.assert_array_equal(
+        np.asarray(logits[:, :5]), np.asarray(logits2[:, :5])
+    )
+    assert (np.asarray(logits[:, 5:]) != np.asarray(logits2[:, 5:])).any()
+
+    # cross-attention liveness: changing the ENCODER input moves the
+    # decoder logits everywhere
+    enc2 = enc.at[:, 0].set((enc[:, 0] + 3) % 40)
+    logits3 = model.apply(params, enc2, dec, train=False)
+    assert (np.asarray(logits) != np.asarray(logits3)).all(axis=-1).any()
+
+
+def test_relative_bias_makes_encoder_order_matter():
+    """Swapping two encoder tokens must move the decoder logits: without
+    the relative position bias the encoder stack is permutation-
+    equivariant and cross-attention (a sum over keys) would erase the
+    swap entirely — the bias is the model's only position signal."""
+    model = T5(**_CFG)
+    enc = jnp.asarray(np.arange(1, 11)[None, :], jnp.int32)
+    dec = jnp.asarray(np.arange(11, 17)[None, :], jnp.int32)
+    params = model.init(jax.random.key(1), enc, dec)
+    logits = np.asarray(model.apply(params, enc, dec, train=False))
+    swapped = enc.at[0, 2].set(enc[0, 3]).at[0, 3].set(enc[0, 2])
+    logits_sw = np.asarray(model.apply(params, swapped, dec, train=False))
+    assert not np.allclose(logits, logits_sw)
+
+
+def test_train_step_learns_denoising():
+    """The full compiled step (8-dev DP mesh) learns a deterministic
+    sequence's span-filling: loss collapses toward zero."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.train import create_train_state, make_train_step
+
+    mesh = mesh_lib.create_mesh()
+    model = T5(**_CFG)
+    length = 32
+    base = (np.arange(length) % 37 + 1).astype(np.int32)  # deterministic text
+    tokens = np.tile(base, (16, 1))
+    transform = span_corrupt_transform(64, seed=5)
+
+    tx = optax.adam(1e-2)
+    sample = transform({"tokens": tokens[:1]})
+    state = create_train_state(
+        model, 0,
+        (jnp.asarray(sample["enc_tokens"]), jnp.asarray(sample["dec_tokens"])),
+        tx, mesh,
+    )
+    step = make_train_step(
+        model, tx, mesh, forward_loss=seq2seq_forward(model),
+        input_key="enc_tokens", label_key="targets",
+    )
+    losses = []
+    for i in range(80):
+        batch = transform({"tokens": tokens})
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    # the spans move every step, so the task is "learn the fixed text";
+    # a model that learns it collapses well below the ~3.6-nat entropy
+    # of guessing tokens
+    assert losses[-1] < 1.0 and losses[-1] < losses[0] * 0.25, losses[::10]

@@ -1,7 +1,9 @@
 """Model zoo: the reference's model (ResNet-50, /root/reference/main.py:40)
 plus the BASELINE.json ladder (ResNet-18, ViT-B/16, GPT-2 124M), depth
 variants (ResNet-34/101/152), the Llama decoder family (RoPE/GQA/SwiGLU),
-and the BERT encoder family (bidirectional + masked-LM objective)."""
+the BERT encoder family (bidirectional + masked-LM objective), and the T5
+encoder-decoder family (relative-position-bias attention + span
+corruption)."""
 
 from tpudist.models.resnet import (
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
@@ -14,11 +16,12 @@ from tpudist.models.llama import (
 from tpudist.models.bert import (
     Bert, BertClassifier, bert_base, bert_large, classifier_params_from_mlm,
 )
+from tpudist.models.t5 import T5, t5_small
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "ViT", "vit_b16", "GPT2", "gpt2_124m", "gpt2_medium", "gpt2_large",
     "Llama", "llama_125m", "llama2_7b", "llama3_8b", "mixtral_8x7b",
     "Bert", "BertClassifier", "bert_base", "bert_large",
-    "classifier_params_from_mlm",
+    "classifier_params_from_mlm", "T5", "t5_small",
 ]

@@ -1,0 +1,348 @@
+"""T5-style encoder-decoder with a span-corruption objective.
+
+No reference counterpart (the reference is a single ResNet DDP script,
+SURVEY.md §2.12); built as a capability extension completing the
+framework's architecture classes: decoders (GPT-2/Llama), encoder (BERT),
+vision (ResNet/ViT) — and now the encoder-decoder. Shares the framework
+contracts: Megatron TP metadata over the ``tensor`` axis on qkv/out/MLP
+kernels, the ``forward_loss`` train-step interface
+(:func:`seq2seq_forward` plugs into ``make_train_step`` like
+``mlm_forward``), and a host-side loader transform for the objective
+(:func:`span_corrupt_transform`, the T5 counterpart of BERT's
+``mlm_transform``).
+
+Architecture follows the T5 v1.1 conventions: pre-RMSNorm blocks, NO
+biases anywhere, bucketed relative position bias on self-attention
+(shared across the stack's layers, bidirectional buckets in the encoder,
+causal buckets in the decoder; none on cross-attention), gated-GELU MLP,
+un-tied LM head, and un-scaled attention scores (the 1/sqrt(d) factor is
+folded into initialization instead).
+
+Span corruption runs host-side with FIXED counts per window (exactly
+``noise`` corrupted tokens in exactly ``spans`` spans), so every example
+in a batch has the same encoder/decoder lengths and the device step stays
+static-shaped with no padding or masks at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tpudist.mesh import TENSOR_AXIS
+from tpudist.parallel.tp import partitioned as _partitioned
+
+
+def _rms_norm(dtype, name):
+    """T5's LayerNorm: scale-only RMS normalization (flax's nn.RMSNorm —
+    the same module llama.py uses for the identical convention)."""
+    return nn.RMSNorm(epsilon=1e-6, dtype=dtype, name=name)
+
+
+def relative_position_buckets(q_len: int, k_len: int, *, bidirectional: bool,
+                              num_buckets: int = 32, max_distance: int = 128):
+    """[q_len, k_len] int32 bucket ids for the learned relative bias.
+
+    Log-binned distance buckets: half the buckets cover exact small
+    offsets, the rest log-space out to ``max_distance``; bidirectional
+    stacks split the budget between past and future. (The bucketing
+    function class of relative-attention biases, computed here on static
+    iota so XLA folds it to a constant.)
+    """
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    rel = mem - ctx  # >0 = future key
+    buckets = 0
+    n = num_buckets
+    if bidirectional:
+        n = n // 2
+        buckets = jnp.where(rel > 0, n, 0)
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)  # causal: only past distances
+    max_exact = n // 2
+    is_small = rel < max_exact
+    log_pos = max_exact + (
+        jnp.log(jnp.maximum(rel, 1) / max_exact)
+        / np.log(max_distance / max_exact) * (n - max_exact)
+    ).astype(jnp.int32)
+    log_pos = jnp.minimum(log_pos, n - 1)
+    return buckets + jnp.where(is_small, rel, log_pos)
+
+
+def _attention(q, k, v, *, bias=None, causal=False):
+    """Un-scaled dot-product attention with an additive [H, Sq, Sk] bias —
+    T5's flavor (no 1/sqrt(d); the bias carries the relative positions).
+    Routed through the shared oracle (tpudist.ops.attention) so the
+    softmax/masking numerics have one home. Shapes: q [B, Sq, H, Dh],
+    k/v [B, Sk, H, Dh]."""
+    from tpudist.ops.attention import dot_product_attention
+
+    return dot_product_attention(
+        q, k, v, causal=causal, scale=1.0,
+        bias=None if bias is None else bias[None],
+    )
+
+
+class _Attention(nn.Module):
+    """qkv/out projections (no biases) with the shared Megatron TP scheme;
+    ``kv`` defaults to the query stream (self-attention) or takes the
+    encoder output (cross-attention)."""
+
+    num_heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, kv=None, *, bias=None, causal=False):
+        d = x.shape[-1]
+        h = self.num_heads
+        kv = x if kv is None else kv
+        init = nn.initializers.lecun_normal()
+        proj = lambda name, src: nn.DenseGeneral(
+            (h, d // h), dtype=self.dtype, use_bias=False, name=name,
+            kernel_init=_partitioned(init, None, TENSOR_AXIS, None),
+        )(src)
+        q, k, v = proj("q", x), proj("k", kv), proj("v", kv)
+        attn = _attention(q, k, v, bias=bias, causal=causal)
+        return nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, use_bias=False, name="out",
+            kernel_init=_partitioned(init, TENSOR_AXIS, None, None),
+        )(attn)
+
+
+class _GatedMlp(nn.Module):
+    """T5 v1.1 MLP: gelu(wi_0(x)) * wi_1(x) -> wo, no biases."""
+
+    ffn_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        init = nn.initializers.lecun_normal()
+        col = lambda name: nn.Dense(
+            self.ffn_dim, dtype=self.dtype, use_bias=False, name=name,
+            kernel_init=_partitioned(init, None, TENSOR_AXIS),
+        )
+        y = nn.gelu(col("wi_0")(x), approximate=False) * col("wi_1")(x)
+        return nn.Dense(
+            d, dtype=self.dtype, use_bias=False, name="wo",
+            kernel_init=_partitioned(init, TENSOR_AXIS, None),
+        )(y)
+
+
+class _EncoderBlock(nn.Module):
+    num_heads: int
+    ffn_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, bias):
+        y = _rms_norm(self.dtype, "ln_attn")(x)
+        x = x + _Attention(self.num_heads, dtype=self.dtype, name="attn")(
+            y, bias=bias
+        )
+        y = _rms_norm(self.dtype, "ln_mlp")(x)
+        return x + _GatedMlp(self.ffn_dim, dtype=self.dtype, name="mlp")(y)
+
+
+class _DecoderBlock(nn.Module):
+    num_heads: int
+    ffn_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, enc, bias):
+        y = _rms_norm(self.dtype, "ln_self")(x)
+        x = x + _Attention(self.num_heads, dtype=self.dtype, name="self_attn")(
+            y, bias=bias, causal=True
+        )
+        y = _rms_norm(self.dtype, "ln_cross")(x)
+        # cross-attention carries no relative bias (T5 convention)
+        x = x + _Attention(self.num_heads, dtype=self.dtype, name="cross_attn")(
+            y, kv=enc
+        )
+        y = _rms_norm(self.dtype, "ln_mlp")(x)
+        return x + _GatedMlp(self.ffn_dim, dtype=self.dtype, name="mlp")(y)
+
+
+class T5(nn.Module):
+    """Encoder-decoder transformer (T5 v1.1 conventions).
+
+    ``__call__(enc_tokens [B, Se], dec_tokens [B, Sd])`` → fp32 logits
+    ``[B, Sd, vocab]``. ``return_hidden=True`` returns the decoder's final
+    hidden states (the chunked-head hook, mirroring the other families).
+    """
+
+    vocab_size: int = 512
+    hidden_dim: int = 256
+    ffn_dim: int = 512
+    enc_depth: int = 4
+    dec_depth: int = 4
+    num_heads: int = 4
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, enc_tokens, dec_tokens=None, train: bool = True,
+                 return_hidden: bool = False):
+        if dec_tokens is None:
+            # the single-sample-input convention of create_train_state:
+            # two-stream models take an (enc, dec) tuple as the one input
+            enc_tokens, dec_tokens = enc_tokens
+        wte = self.param(
+            "wte",
+            _partitioned(nn.initializers.normal(1.0), TENSOR_AXIS, None),
+            (self.vocab_size, self.hidden_dim), jnp.float32,
+        )
+        se, sd = enc_tokens.shape[1], dec_tokens.shape[1]
+
+        def rel_bias(name, q_len, k_len, bidirectional):
+            table = self.param(
+                name, nn.initializers.normal(0.4),
+                (self.rel_buckets, self.num_heads), jnp.float32,
+            )
+            buckets = relative_position_buckets(
+                q_len, k_len, bidirectional=bidirectional,
+                num_buckets=self.rel_buckets,
+                max_distance=self.rel_max_distance,
+            )
+            return jnp.transpose(table[buckets], (2, 0, 1))  # [H, Sq, Sk]
+
+        # ---- encoder (bias shared by every layer — T5 convention) ----
+        x = wte[enc_tokens].astype(self.dtype)
+        enc_bias = rel_bias("enc_rel_bias", se, se, True)
+        for i in range(self.enc_depth):
+            x = _EncoderBlock(
+                self.num_heads, self.ffn_dim, dtype=self.dtype,
+                name=f"enc_{i}",
+            )(x, enc_bias)
+        enc = _rms_norm(self.dtype, "ln_enc")(x)
+
+        # ---- decoder ----
+        y = wte[dec_tokens].astype(self.dtype)
+        dec_bias = rel_bias("dec_rel_bias", sd, sd, False)
+        for i in range(self.dec_depth):
+            y = _DecoderBlock(
+                self.num_heads, self.ffn_dim, dtype=self.dtype,
+                name=f"dec_{i}",
+            )(y, enc, dec_bias)
+        y = _rms_norm(self.dtype, "ln_dec")(y)
+        if return_hidden:
+            return y
+        # un-tied head (v1.1), fp32 logits
+        return nn.Dense(
+            self.vocab_size, dtype=self.dtype, use_bias=False, name="lm_head",
+            kernel_init=_partitioned(
+                nn.initializers.normal(0.05), None, TENSOR_AXIS
+            ),
+        )(y).astype(jnp.float32)
+
+
+def t5_small(**kw) -> T5:
+    """t5-v1.1-small geometry: 512 hidden, 8 enc + 8 dec layers, 6 heads,
+    1024 ffn."""
+    kw.setdefault("hidden_dim", 512)
+    kw.setdefault("ffn_dim", 1024)
+    kw.setdefault("enc_depth", 8)
+    kw.setdefault("dec_depth", 8)
+    kw.setdefault("num_heads", 6)
+    return T5(**kw)
+
+
+def span_corruption_plan(length: int, *, density: float = 0.15,
+                        mean_span: float = 3.0):
+    """(noise_tokens, n_spans, enc_len, dec_len) for a window of
+    ``length`` tokens — FIXED counts, so every example shares one shape."""
+    noise = max(1, int(round(length * density)))
+    spans = max(1, int(round(noise / mean_span)))
+    spans = min(spans, noise)  # every span holds >= 1 token
+    enc_len = length - noise + spans
+    dec_len = noise + spans + 1  # sentinels + spans + EOS
+    return noise, spans, enc_len, dec_len
+
+
+def span_corrupt_transform(
+    vocab_size: int, *, density: float = 0.15, mean_span: float = 3.0,
+    seed: int = 0, key: str = "tokens", start_id: int = 0,
+):
+    """Loader transform applying T5 span corruption on the host.
+
+    Exactly ``noise`` tokens in exactly ``spans`` contiguous spans are
+    removed from each window and replaced by one sentinel each (ids
+    ``vocab_size-1`` downward); the decoder target is the concatenation
+    ``sentinel_0, span_0, sentinel_1, span_1, ..., EOS`` (EOS =
+    ``vocab_size - spans - 1``), and the decoder input is the target
+    shifted right behind ``start_id``. Fixed counts → fixed shapes → no
+    padding, no masks. Produces ``{"enc_tokens", "dec_tokens",
+    "targets"}``; data vocab ids must stay below the sentinel/EOS range.
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+
+    def run(batch):
+        tokens = np.asarray(batch[key])
+        b, length = tokens.shape
+        noise, spans, enc_len, dec_len = span_corruption_plan(
+            length, density=density, mean_span=mean_span
+        )
+        sentinels = vocab_size - 1 - np.arange(spans)
+        eos = vocab_size - spans - 1
+        enc = np.empty((b, enc_len), tokens.dtype)
+        dec = np.empty((b, dec_len), tokens.dtype)
+        tgt = np.empty((b, dec_len), tokens.dtype)
+        for i in range(b):
+            # random composition: `noise` into `spans` positive parts,
+            # `length - noise` into `spans + 1` non-negative gaps
+            span_cuts = np.sort(
+                rng.choice(noise - 1, size=spans - 1, replace=False)
+            ) + 1 if spans > 1 else np.empty(0, np.int64)
+            span_lens = np.diff(np.r_[0, span_cuts, noise])
+            free = length - noise
+            gap_cuts = np.sort(rng.integers(0, free + 1, size=spans))
+            gaps = np.diff(np.r_[0, gap_cuts, free])
+            e, t, pos = [], [], 0
+            for s in range(spans):
+                e.append(tokens[i, pos:pos + gaps[s]])
+                pos += gaps[s]
+                e.append(sentinels[s:s + 1].astype(tokens.dtype))
+                t.append(sentinels[s:s + 1].astype(tokens.dtype))
+                t.append(tokens[i, pos:pos + span_lens[s]])
+                pos += span_lens[s]
+            e.append(tokens[i, pos:])
+            t.append(np.asarray([eos], tokens.dtype))
+            enc[i] = np.concatenate(e)
+            tgt[i] = np.concatenate(t)
+            dec[i, 0] = start_id
+            dec[i, 1:] = tgt[i, :-1]
+        out = dict(batch)
+        out.pop(key, None)
+        out["enc_tokens"] = enc
+        out["dec_tokens"] = dec
+        out["targets"] = tgt
+        return out
+
+    return run
+
+
+def seq2seq_forward(model: T5):
+    """``forward_loss`` for ``make_train_step``: mean CE of the decoder
+    logits against the span targets (every target position is real — the
+    fixed-count corruption produces no padding). Expects batches from
+    :func:`span_corrupt_transform`."""
+    import optax
+
+    def forward_loss(params, batch_stats, batch):
+        logits = model.apply(
+            {"params": params}, batch["enc_tokens"], batch["dec_tokens"],
+            train=True,
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["targets"]
+        ).mean()
+        return loss, batch_stats
+
+    return forward_loss

@@ -47,14 +47,23 @@ def kernel_attention(q, k, v, *, causal: bool = False):
     return multi_head_attention(q, k, v, causal=causal, impl="auto")
 
 
-def dot_product_attention(q, k, v, *, causal: bool = False, mask=None):
-    """q,k,v: [B, S, H, D] (batch, seq, heads, head_dim) → [B, S, H, D]."""
+def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
+                          bias=None, scale=None):
+    """q,k,v: [B, S, H, D] (batch, seq, heads, head_dim) → [B, S, H, D].
+
+    ``bias``: optional additive score bias broadcastable to
+    ``[B, H, Sq, Sk]`` (T5's relative position bias). ``scale`` overrides
+    the default ``1/sqrt(D)`` (T5 uses 1.0 — the scale is folded into its
+    init)."""
     dtype = q.dtype
     depth = q.shape[-1]
-    scale = 1.0 / np.sqrt(depth).astype(np.float32)
+    if scale is None:
+        scale = 1.0 / np.sqrt(depth).astype(np.float32)
     # compute scores in float32 for stability, cast back at the end
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         causal_mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
