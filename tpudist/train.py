@@ -390,6 +390,7 @@ def fit(
     checkpoint_every: int = 0,
     resume: bool = True,
     init_params=None,
+    init_input=None,
 ) -> tuple[TrainState, list[float]]:
     """The reference's whole training program (/root/reference/main.py:86-117)
     as a function: epochs × batches, per-epoch sampler re-shuffle, windowed
@@ -417,21 +418,26 @@ def fit(
         # (the reference's per-GPU --batch_size, main.py:25)
         batch_size = train_loader.batch_size // jax.local_device_count()
 
-    # shape/dtype probe: one gathered sample where the loader supports it
-    # (a full first batch would e.g. JPEG-decode the whole thing twice)
-    sample = (
-        train_loader.probe()
-        if hasattr(train_loader, "probe")
-        else next(iter(train_loader))
-    )
     # init sample batch = the mesh's replica count, not 1: models with manual
     # (shard_map) axes — ring/Ulysses attention — refuse traces whose batch
-    # doesn't divide the mesh; zeros keep init cheap and content-independent
-    sample_in = np.asarray(sample[input_key])
-    init_input = jnp.zeros(
-        (mesh_lib.data_parallel_size(mesh), *sample_in.shape[1:]),
-        sample_in.dtype,
-    )
+    # doesn't divide the mesh; zeros keep init cheap and content-independent.
+    # ``init_input`` overrides the probe-derived shape for models whose
+    # init takes more than batch[input_key] (e.g. T5's (enc, dec) tuple) —
+    # and skips the probe entirely (its only consumer).
+    if init_input is None:
+        # shape/dtype probe: one gathered sample where the loader supports
+        # it (a full first batch would e.g. JPEG-decode the whole thing
+        # twice)
+        sample = (
+            train_loader.probe()
+            if hasattr(train_loader, "probe")
+            else next(iter(train_loader))
+        )
+        sample_in = np.asarray(sample[input_key])
+        init_input = jnp.zeros(
+            (mesh_lib.data_parallel_size(mesh), *sample_in.shape[1:]),
+            sample_in.dtype,
+        )
     state = create_train_state(model, seed, init_input, tx, mesh)
     if init_params is not None:
         # warm-start (e.g. an HF checkpoint through tpudist.interop):
