@@ -23,15 +23,33 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
-# persistent compilation cache: the suite re-jits the same train steps many
-# times (each fit() in its own test); caching compiled executables across
-# tests and across runs cuts the suite from ~10min to ~2min on CPU.
-# The dir is keyed by a hash of the host's CPU flags: XLA:CPU AOT results
-# only WARN on a feature mismatch and then can SIGABRT mid-run (observed
-# after a host migration under this environment's VM scheduler) — a
-# per-feature-set dir turns that crash into a cold compile.
-from tpudist.utils.cache import host_keyed_cache_dir  # noqa: E402
+# persistent compilation cache (host-CPU-keyed dir, tpudist/utils/cache.py;
+# opt OUT with TPUDIST_NO_JAX_CACHE=1): without it the 1-core cold suite
+# runs >1h, far past any CI budget. Known environment wart: XLA:CPU AOT
+# entries load with a machine-feature MISMATCH warning here (compile-side
+# target advertises +prefer-no-scatter/+gather the executing host lacks),
+# and under heavy multi-job contention the suite has twice SIGABRT'd in
+# one ring-collective value fetch — that single test is subprocess-
+# contained with a retry (tests/test_bert.py) so a crash can never take
+# down a whole run. If aborts spread, flip the env switch and purge
+# /tmp/tpudist_jax_cache*.
+if os.environ.get("TPUDIST_NO_JAX_CACHE", "").lower() not in ("1", "true", "yes"):
+    from tpudist.utils.cache import host_keyed_cache_dir
 
-jax.config.update("jax_compilation_cache_dir", host_keyed_cache_dir())
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_compilation_cache_dir", host_keyed_cache_dir())
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Tests marked ``subproc_only`` run ONLY inside their wrapper's child
+    process (TPUDIST_SUBPROC_TEST=1) — the containment mechanism for the
+    crash-capable ring-collective test (see test_bert.py)."""
+    import pytest as _pytest
+
+    if os.environ.get("TPUDIST_SUBPROC_TEST"):
+        return
+    skip = _pytest.mark.skip(reason="runs only inside its subprocess wrapper")
+    for item in items:
+        if "subproc_only" in item.keywords:
+            item.add_marker(skip)

@@ -6,6 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from tpudist import mesh as mesh_lib
 from tpudist.models.bert import Bert, mlm_forward, mlm_transform
@@ -329,9 +330,54 @@ def test_ulysses_matches_full_bidirectional():
     )
 
 
+@pytest.mark.slow  # spawns a fresh jax world (the repo's subprocess-test convention)
 def test_ring_mlm_train_step_with_sequence_sharded_batch():
+    """Subprocess-contained wrapper around the real test below: under
+    heavy host contention this ring-collective step has twice SIGABRT'd
+    inside XLA:CPU's runtime (an environment wart — the persistent-cache
+    note in tests/conftest.py has the full diagnosis). In-process, that
+    abort kills the entire pytest run and every result with it; contained,
+    a crash is one retried (then failed) test. One retry absorbs the
+    observed flake rate."""
+    import os
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "pytest", "-q", "-x",
+        f"{__file__}::test_ring_mlm_subproc_impl",
+    ]
+    env = dict(os.environ, TPUDIST_SUBPROC_TEST="1")
+    r = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, env=env
+    )
+    if r.returncode < 0 or r.returncode == 134:
+        # killed by a signal (the SIGABRT this wrapper contains): retry
+        # once, LOUDLY — the recovery must stay observable so a spreading
+        # flake is noticed before both attempts die
+        print(
+            f"\nring MLM subprocess CRASHED (rc={r.returncode}) — the known "
+            "XLA:CPU abort (tests/conftest.py); retrying once:\n"
+            + r.stderr[-1500:],
+            file=sys.stderr,
+        )
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=600, env=env
+        )
+    # an ordinary test failure (rc>0) reports immediately — retrying would
+    # only mask a real regression and double the wall clock
+    assert r.returncode == 0, (
+        f"ring MLM subprocess failed (rc={r.returncode}):\n"
+        + r.stdout[-2000:] + r.stderr[-2000:]
+    )
+
+
+@pytest.mark.subproc_only
+def test_ring_mlm_subproc_impl():
     """Context-parallel MLM training: tokens/targets/mask sharded over the
-    'seq' axis, ring attention inside the compiled step."""
+    'seq' axis, ring attention inside the compiled step. Collected only
+    inside the wrapper's subprocess (the subproc_only marker skips it in
+    the parent run — tests/conftest.py)."""
     from jax.sharding import PartitionSpec as P
 
     mesh_sp = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=4, seq=2))
