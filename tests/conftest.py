@@ -25,13 +25,14 @@ jax.config.update("jax_threefry_partitionable", True)
 
 # persistent compilation cache (host-CPU-keyed dir, tpudist/utils/cache.py;
 # opt OUT with TPUDIST_NO_JAX_CACHE=1): without it the 1-core cold suite
-# runs >1h, far past any CI budget. Known environment wart: XLA:CPU AOT
-# entries load with a machine-feature MISMATCH warning here (compile-side
-# target advertises +prefer-no-scatter/+gather the executing host lacks),
-# and under heavy multi-job contention the suite has twice SIGABRT'd in
-# one ring-collective value fetch — that single test is subprocess-
-# contained with a retry (tests/test_bert.py) so a crash can never take
-# down a whole run. If aborts spread, flip the env switch and purge
+# runs >1h, far past any CI budget. Known environment wart: ONE program —
+# the bert ring-collective train step — SIGABRTs in XLA:CPU when executed
+# from a cache-loaded (AOT-deserialized) executable: measured 2/6 child
+# runs abort with the cache, 0/6 without, and capping --xla_cpu_max_isa
+# does not help (so it is the AOT round trip, not the ISA mismatch the
+# cpu_aot_loader warnings suggest). That test runs subprocess-contained
+# and CACHE-LESS (tests/test_bert.py), so a crash cannot take down a
+# whole run. If aborts appear elsewhere, flip the env switch and purge
 # /tmp/tpudist_jax_cache*.
 if os.environ.get("TPUDIST_NO_JAX_CACHE", "").lower() not in ("1", "true", "yes"):
     from tpudist.utils.cache import host_keyed_cache_dir
