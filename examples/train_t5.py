@@ -188,24 +188,53 @@ def main(argv=None):
         )
 
         @jax.jit
-        def score(params, enc, dec, tgt):
+        def score(params, enc, dec, tgt, row_mask):
             import optax
 
             logits = model.apply({"params": params}, enc, dec, train=False)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
             hit = jnp.argmax(logits, axis=-1) == tgt
-            return jnp.sum(ce), jnp.sum(hit), tgt.size
+            rows = row_mask[:, None]
+            return (
+                jnp.sum(jnp.where(rows, ce, 0.0)),
+                jnp.sum(jnp.where(rows, hit, False)),
+                jnp.sum(row_mask) * tgt.shape[1],
+            )
 
+        # globally-accounted, like tpudist.train.evaluate/evaluate_lm: each
+        # process's (disjoint, rank-sharded) rows are staged as ONE global
+        # batch-sharded array padded to the mesh's replica multiple (the
+        # pad rows masked out of every sum), so the in-graph sums are
+        # global sums and every process sees the same totals — a rank-0
+        # print of its local sums would report 1/world of the set on a
+        # real multi-host run, and jitting mesh-global params with
+        # process-local host arrays can fail outright there. Lockstep
+        # holds: drop_remainder=True plus the sampler's stride gives every
+        # process the same batch count.
+        dp = mesh_lib.data_parallel_size(mesh)
         total_ce, total_hit, total_n = 0.0, 0, 0
         for batch in val_loader:
-            ce, hit, n = score(
-                state.params, jnp.asarray(batch["enc_tokens"]),
-                jnp.asarray(batch["dec_tokens"]),
-                jnp.asarray(batch["targets"]),
+            arrs = {k: np.asarray(batch[k])
+                    for k in ("enc_tokens", "dec_tokens", "targets")}
+            n = arrs["targets"].shape[0]
+            pad = -n % (dp // ctx.process_count or 1)
+            if pad:
+                arrs = {
+                    k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                    for k, v in arrs.items()
+                }
+            row_mask = np.arange(n + pad) < n
+            dev = mesh_lib.shard_batch(arrs, mesh)
+            mask_dev = mesh_lib.put_sharded(
+                row_mask, mesh_lib.batch_sharding(mesh, extra_dims=0)
+            )
+            ce, hit, cnt = score(
+                state.params, dev["enc_tokens"], dev["dec_tokens"],
+                dev["targets"], mask_dev,
             )
             total_ce += float(ce)
             total_hit += int(hit)
-            total_n += int(n)
+            total_n += int(cnt)
         if ctx.process_index == 0 and total_n:
             print(
                 f"span_loss: {total_ce / total_n:.4f} "

@@ -204,10 +204,6 @@ def main(argv=None):
         packed = load_packed(args.packed)
         train_classes = packed["classes"]
         pdata = {"image": packed["image"], "label": packed["label"]}
-        sampler = DistributedSampler(
-            len(pdata["label"]), num_replicas=ctx.process_count,
-            rank=ctx.process_index,
-        )
         norm = device_normalize(IMAGENET_MEAN, IMAGENET_STD, dtype=dtype)
         if args.augment:
             # packed pixels are the deterministic eval decode; --augment
@@ -225,8 +221,10 @@ def main(argv=None):
         if args.device_cache and args.cache_shard_rows:
             from tpudist.data.device_cache import RotatingDeviceCache
 
-            # pack larger than HBM: double-buffered shard rotation —
-            # windowed shuffle, every row once per epoch
+            # pack larger than HBM: double-buffered shard rotation with a
+            # windowed shuffle. The rotation is its OWN sampler (its
+            # (seed, epoch) plan replaces the DistributedSampler's global
+            # permutation), so no sampler is built here.
             loader = RotatingDeviceCache(
                 pdata, per_process_batch, mesh=mesh,
                 shard_rows=args.cache_shard_rows,
@@ -237,12 +235,21 @@ def main(argv=None):
 
             # staged pre-compile (same contract as the CIFAR path below)
             loader = DeviceCachedLoader(
-                pdata, per_process_batch, mesh=mesh, sampler=sampler
+                pdata, per_process_batch, mesh=mesh,
+                sampler=DistributedSampler(
+                    len(pdata["label"]), num_replicas=ctx.process_count,
+                    rank=ctx.process_index,
+                ),
             )
             input_transform = loader.input_transform(norm)
         else:
             loader = DataLoader(
-                pdata, per_process_batch, sampler=sampler, transform=None
+                pdata, per_process_batch,
+                sampler=DistributedSampler(
+                    len(pdata["label"]), num_replicas=ctx.process_count,
+                    rank=ctx.process_index,
+                ),
+                transform=None,
             )
             input_transform = norm
     elif args.dataset == "imagenet":

@@ -32,6 +32,7 @@ gather stays collective-free because the cache is replicated.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Mapping
 
 import jax
@@ -40,6 +41,8 @@ import numpy as np
 
 from tpudist import mesh as mesh_lib
 from tpudist.data.sampler import DistributedSampler
+
+logger = logging.getLogger(__name__)
 
 
 def _chunked_device_put(
@@ -258,10 +261,17 @@ class RotatingDeviceCache:
     GLOBAL per-epoch permutation for the standard windowed approximation —
     shard ORDER is permuted per epoch and rows shuffle WITHIN the resident
     shard (window = shard_rows, vastly larger than typical shuffle-buffer
-    windows). Every row is still visited exactly once per epoch, and the
-    (seed, epoch) keying keeps it deterministic and resumable. Recipes
-    that need the exact global permutation use the host loaders or the
-    fully-resident cache.
+    windows). Coverage: when ``shard_rows`` divides the dataset, every row
+    is visited exactly once per epoch; otherwise the ragged TAIL shard is
+    dropped (static shapes — the compiled program sees one
+    ``[shard_rows, ...]`` cache operand), so up to ``shard_rows - 1``
+    rows sit out each epoch. The dropped rows are a fresh random subset
+    per epoch (the (seed, epoch)-keyed permutation runs before sharding),
+    so over a run every row still trains — the same expectation-level
+    coverage as shuffle-buffer pipelines; a warning is logged at
+    construction when the tail exists. The (seed, epoch) keying keeps the
+    plan deterministic and resumable. Recipes that need the exact global
+    permutation use the host loaders or the fully-resident cache.
 
     Works straight off a :func:`tpudist.data.packed.load_packed` memmap:
     each shard's rows are materialized host-side only transiently for the
@@ -309,6 +319,13 @@ class RotatingDeviceCache:
                 "shards)"
             )
         self.shard_rows = shard_rows
+        if self._n % shard_rows:
+            logger.warning(
+                "RotatingDeviceCache: dataset rows (%d) are not a multiple "
+                "of shard_rows (%d); the ragged tail shard is dropped, so "
+                "%d randomly-chosen rows (a fresh subset per epoch) sit "
+                "out each epoch", self._n, shard_rows, self._n % shard_rows,
+            )
         self.epoch = 0
         # fit() drives per-epoch reshuffle via loader.sampler.set_epoch();
         # the rotation owns its epoch keying, so it is its own "sampler"

@@ -1,19 +1,20 @@
 """Parallelism strategies.
 
-The reference's only strategy is data parallelism (DDP, SURVEY.md §2.12) —
-expressed here as shardings over the named mesh (tpudist.mesh +
-tpudist.train). This package holds the strategy-level helpers: DP sharding
-rules and grad accumulation; the mesh's extra named axes (fsdp/tensor/seq/
-expert) keep the door open for further strategies beyond parity.
+The reference's only strategy is data parallelism (DDP, SURVEY.md §2.12).
+DP has no module here because its shardings ARE the framework defaults:
+params replicated (``tpudist.mesh.replicated_sharding``), batch split over
+the ``data`` axis (``tpudist.mesh.batch_sharding``), consumed directly by
+``make_train_step`` — the gradient all-reduce is implicit in ``jax.grad``
+of a global-batch mean under GSPMD. This package holds the strategies
+BEYOND parity (tp/pp/cp/ep/fsdp) over the mesh's extra named axes.
 """
 
-from tpudist.parallel.dp import dp_shardings
 from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch
 from tpudist.parallel.fsdp import fsdp_shardings, shard_state
 from tpudist.parallel.pp import pipeline_apply, stacked_param_shardings
 
 __all__ = [
-    "dp_shardings", "fsdp_shardings", "shard_state",
+    "fsdp_shardings", "shard_state",
     "pipeline_apply", "stacked_param_shardings",
     "MoEMlp", "expert_capacity", "top_k_dispatch",
 ]
