@@ -24,8 +24,14 @@ def _tokens(b=2, s=12, vocab=64, seed=0):
         GPT2(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=4),
         Llama(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
               num_heads=4, num_kv_heads=2, ffn_dim=64),
+        # attn_impl != "xla" routes decode through the FUSED Pallas kernel
+        # (tpudist.ops.decode.decode_attention) — same contract, one launch
+        GPT2(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+             num_heads=4, attn_impl="vmem"),
+        Llama(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+              num_heads=4, num_kv_heads=2, ffn_dim=64, attn_impl="vmem"),
     ],
-    ids=["gpt2", "llama-gqa"],
+    ids=["gpt2", "llama-gqa", "gpt2-fused", "llama-gqa-fused"],
 )
 def test_incremental_decode_matches_full_forward(model):
     tokens = _tokens()
@@ -135,6 +141,79 @@ def test_sample_logits_top_p_nucleus():
         for i in range(40)
     }
     assert combo <= {0, 1}
+
+
+@pytest.mark.parametrize(
+    "b,s,h,hkv,dh,pos",
+    [(2, 64, 4, 4, 16, 10), (2, 64, 4, 2, 16, 0), (3, 128, 6, 1, 32, 127)],
+)
+def test_fused_decode_attention_matches_oracle(b, s, h, hkv, dh, pos):
+    """The one-launch decode kernel ≡ masked dense attention, including
+    GQA head grouping and the pos=0 single-valid-slot edge. K/V arrive in
+    the cache's head-major [B, H_kv, S, dh] layout (cached_kv's contract)."""
+    from tpudist.ops.attention import dot_product_attention, repeat_kv
+    from tpudist.ops.decode import _fused_decode_attention, decode_attention
+
+    rng = np.random.Generator(np.random.PCG64(7))
+    q = jnp.asarray(rng.standard_normal((b, 1, h, dh)), jnp.float32)
+    keys = jnp.asarray(rng.standard_normal((b, hkv, s, dh)), jnp.float32)
+    values = jnp.asarray(rng.standard_normal((b, hkv, s, dh)), jnp.float32)
+    out = _fused_decode_attention(q, keys, values, jnp.int32(pos))
+    mask = jnp.arange(s)[None, None, None, :] <= pos
+    # oracle in the models' seq-major activation layout
+    kr, vr = repeat_kv(q, keys.transpose(0, 2, 1, 3),
+                       values.transpose(0, 2, 1, 3))
+    ref = dot_product_attention(q, kr, vr, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # and the dispatcher's own dense path agrees too (impl="xla")
+    dense = decode_attention(q, keys, values, mask, jnp.int32(pos), impl="xla")
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ref), atol=1e-5)
+
+
+def test_sampler_topk_topp_threshold_equals_full_sort():
+    """The composed top_k+top_p filter computes its nucleus threshold from
+    the top-k values alone (no [B, V] sort per token). Checked BOTH ways
+    against the full-sort reference formulation on tie-free float logits
+    (exact k-th-value ties legitimately differ — the subset sampler keeps
+    exactly k ids, the threshold form keeps every tied id):
+    no over-keeping (every sampled id is reference-kept) and no
+    over-filtering (every reference-kept id with non-trivial mass is
+    eventually sampled)."""
+    rng = np.random.Generator(np.random.PCG64(3))
+    logits = jnp.asarray(rng.standard_normal((5, 512)) * 3, jnp.float32)
+    top_k, top_p = 50, 0.9
+
+    # full-sort reference (the pre-optimization formulation)
+    kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+    filt = jnp.where(logits < kth, -jnp.inf, logits)
+    sorted_desc = jnp.flip(jnp.sort(filt, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    excl = jnp.cumsum(probs, axis=-1) - probs
+    thresh = jnp.min(
+        jnp.where(excl < top_p, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    ref_kept = np.asarray(filt >= thresh)
+    ref_probs = np.asarray(jax.nn.softmax(
+        jnp.where(jnp.asarray(ref_kept), logits, -jnp.inf), axis=-1
+    ))
+
+    seen = set()
+    for i in range(300):
+        tok = sample_logits(
+            logits, jax.random.key(i), temperature=1.0, top_k=top_k,
+            top_p=top_p,
+        )
+        seen.update((r, int(t)) for r, t in enumerate(np.asarray(tok)))
+    # direction 1 — no over-keeping: nothing outside the reference set
+    for r, t in seen:
+        assert ref_kept[r, t], (r, t)
+    # direction 2 — no over-filtering: every reference-kept id carrying
+    # >= 5% mass must show up in 300 draws (P(miss) <= 0.95^300 ≈ 2e-7
+    # per id; an over-filtering bug — e.g. nucleus `<=` for `<`, or a
+    # too-small subset — makes its dropped ids NEVER appear)
+    for r in range(ref_kept.shape[0]):
+        for t in np.nonzero(ref_kept[r] & (ref_probs[r] >= 0.05))[0]:
+            assert (r, int(t)) in seen, (r, int(t), ref_probs[r, t])
 
 
 def test_generate_with_tensor_sharded_params():

@@ -74,11 +74,16 @@ class Block(nn.Module):
                     f"attn_impl={self.attn_impl!r} has no decode path; "
                     "generate with the xla/flash model"
                 )
-            from tpudist.ops.attention import dot_product_attention
-            from tpudist.ops.decode import cached_kv
+            from tpudist.ops.decode import cached_kv, decode_attention
 
-            keys, values, mask, _ = cached_kv(self, k, v, max_len)
-            attn = dot_product_attention(q, keys, values, mask=mask)
+            keys, values, mask, pos = cached_kv(self, k, v, max_len)
+            # one fused Pallas launch per layer per token unless the caller
+            # pinned the dense oracle (attn_impl="xla") — decode is
+            # launch-bound, not bandwidth-bound (docs/PERF.md §7)
+            attn = decode_attention(
+                q, keys, values, mask, pos,
+                impl="xla" if self.attn_impl == "xla" else "fused",
+            )
         elif self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
             # context-parallel attention over the 'seq' mesh axis
             # (tpudist.parallel.cp); activations arrive sequence-sharded and

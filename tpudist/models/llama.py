@@ -96,8 +96,7 @@ class LlamaBlock(nn.Module):
                     f"attn_impl={self.attn_impl!r} has no decode path; "
                     "generate with the xla/flash model"
                 )
-            from tpudist.ops.attention import dot_product_attention
-            from tpudist.ops.decode import cached_kv
+            from tpudist.ops.decode import cached_kv, decode_attention
 
             def rotate_k(k, v, pos):
                 positions = (pos + jnp.arange(s)).astype(jnp.float32)
@@ -109,11 +108,12 @@ class LlamaBlock(nn.Module):
             )
             q = apply_rope(q, theta=self.rope_theta,
                            positions=(pos + jnp.arange(s)).astype(jnp.float32))
-            if kv != h:
-                from tpudist.ops.attention import repeat_kv
-
-                keys, values = repeat_kv(q, keys, values)
-            attn = dot_product_attention(q, keys, values, mask=mask)
+            # fused path reads grouped K/V heads natively (no repeat in
+            # HBM); the dense oracle repeats inside decode_attention
+            attn = decode_attention(
+                q, keys, values, mask, pos,
+                impl="xla" if self.attn_impl == "xla" else "fused",
+            )
         else:
             q = apply_rope(q, theta=self.rope_theta)
             k = apply_rope(k, theta=self.rope_theta)

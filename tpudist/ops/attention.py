@@ -24,18 +24,25 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def repeat_kv(q, k, v):
+def repeat_kv(q, k, v, *, head_axis: int = 2):
     """Broadcast grouped K/V heads over their query groups ([.., H_kv, D] →
     [.., H, D]) — the GQA normalization for attention paths that need equal
     head counts. XLA fuses the repeat into the attention matmuls. One home
-    for the ratio math: callers must not hand-roll the repeat."""
-    h, h_kv = q.shape[2], k.shape[2]
+    for the ratio math: callers must not hand-roll the repeat.
+
+    ``head_axis``: where K/V carry their head dim — 2 for the models'
+    ``[B, S, H, D]`` activation layout (default), 1 for the decode cache's
+    head-major ``[B, H, S, D]`` (q stays ``[B, s, H, D]`` either way)."""
+    h, h_kv = q.shape[2], k.shape[head_axis]
     if h % h_kv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     rep = h // h_kv
     if rep == 1:
         return k, v
-    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+    return (
+        jnp.repeat(k, rep, axis=head_axis),
+        jnp.repeat(v, rep, axis=head_axis),
+    )
 
 
 def kernel_attention(q, k, v, *, causal: bool = False):

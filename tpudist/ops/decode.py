@@ -2,16 +2,52 @@
 
 No reference counterpart (the reference trains a CNN); this serves the LM
 families' generation path (:mod:`tpudist.generate`). TPU-first shape
-discipline: the cache is a fixed ``[B, max_len, H, dh]`` buffer updated with
-``dynamic_update_slice`` and attention masks are computed against the full
-buffer — everything static-shaped, so one compiled step serves every
-position and ``lax.scan`` drives the whole generation loop in-graph.
+discipline: the cache is a fixed head-major ``[B, H, max_len, dh]`` buffer
+updated with ``dynamic_update_slice`` and attention masks are computed
+against the full buffer — everything static-shaped, so one compiled step
+serves every position and ``lax.scan`` drives the whole generation loop
+in-graph. Head-major layout is deliberate: each (batch, head) pair's
+``[S, dh]`` cache panel is contiguous, which is exactly the tile the fused
+kernel DMAs per grid step (Pallas TPU blocks must keep their trailing two
+dims whole or 8/128-aligned — a seq-major layout cannot slice one head
+without violating that).
+
+Two attention paths over the cache (:func:`decode_attention` dispatches):
+
+- ``xla``: the dense oracle — einsum scores over the full buffer with the
+  slot mask; ~10 small kernels per layer per token.
+- ``fused``: ONE Pallas launch per layer (:func:`_fused_decode_attention`)
+  computing scores + slot mask + softmax + value mix for every head. A
+  batch-8 decode step dispatches ~300 µs-scale kernels and is
+  launch-bound, not bandwidth-bound (docs/PERF.md §7); collapsing the
+  ~6-kernel attention chain into one launch attacks the kernel-count term
+  directly. Grid is (batch,): each step DMAs the row's whole contiguous
+  [H_kv, S, dh] K/V — the mandatory cache read — and loops heads
+  in-kernel, so the kernel rides the byte floor with no score/prob
+  intermediates in HBM and no per-head grid overhead (the per-(b, h)
+  grid variant measured slower; see the function docstring).
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+# Measured crossover (v5e, GPT-2 124M decode, interleaved A/B medians with
+# the subset sampler): the fused kernel wins at batch 8 (4.81 vs 5.16
+# ms/step) and loses from batch 32 up (7.60 vs 6.89 at 32; 19.95 vs 11.48
+# at 128) — at serving batch XLA's batched attention GEMMs beat the
+# kernel's per-row head loop, while at latency batch the kernel's single
+# launch beats XLA's ~6-kernel chain. The dispatcher falls back to the
+# dense path above this bound.
+FUSED_MAX_BATCH = 16
 
 
 def cached_kv(module, k, v, max_len: int, pre_update=None):
@@ -26,20 +62,22 @@ def cached_kv(module, k, v, max_len: int, pre_update=None):
     step's absolute position — RoPE models rotate keys here so the cache
     holds position-encoded keys.
 
-    Returns ``(keys, values, mask, position)``: the full cache buffers, a
-    ``[1, 1, s, max_len]`` attention mask over valid (already-written)
-    slots, and the integer position where this step was written (for
-    RoPE / learned-position lookup).
+    Returns ``(keys, values, mask, position)``: the full head-major
+    ``[B, H, max_len, dh]`` cache buffers, a ``[1, 1, s, max_len]``
+    attention mask over valid (already-written) slots, and the integer
+    position where this step was written (for RoPE / learned-position
+    lookup). Feed the buffers to :func:`decode_attention` — they are NOT
+    in the models' ``[B, S, H, dh]`` activation layout.
     """
     b, s, h, dh = k.shape
     # the init trace only CREATES the cache (shape/dtype); mutating there
     # would hand callers a cache already advanced past position 0
     initialized = module.has_variable("cache", "cached_key")
     ck = module.variable(
-        "cache", "cached_key", jnp.zeros, (b, max_len, h, dh), k.dtype
+        "cache", "cached_key", jnp.zeros, (b, h, max_len, dh), k.dtype
     )
     cv = module.variable(
-        "cache", "cached_value", jnp.zeros, (b, max_len, h, dh), v.dtype
+        "cache", "cached_value", jnp.zeros, (b, h, max_len, dh), v.dtype
     )
     ci = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -48,8 +86,10 @@ def cached_kv(module, k, v, max_len: int, pre_update=None):
     if pre_update is not None:
         k, v = pre_update(k, v, pos)
     if initialized:
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
+        kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, H, s, dh]
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        ck.value = jax.lax.dynamic_update_slice(ck.value, kt, (0, 0, pos, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, vt, (0, 0, pos, 0))
         ci.value = pos + s
     # slot t is attendable by step row i iff t <= pos + i (causal over the
     # buffer; unwritten slots are masked out entirely)
@@ -57,3 +97,129 @@ def cached_kv(module, k, v, max_len: int, pre_update=None):
     rows = pos + jnp.arange(s)[None, None, :, None]
     mask = slots <= rows
     return ck.value, cv.value, mask, pos
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale, h, ratio):
+    """One grid step = one batch row: all ``h`` query heads against this
+    row's whole cache block [H_kv, S, dh]; slots past the write position
+    are masked. Scores and probs live only in VMEM/registers. The head
+    loop is a fori_loop (one head's code compiled, per-head VMEM scratch
+    reused — the grouping that kept the vmem attention kernel off the
+    grid-overhead cliff applies doubly here, where per-head compute is a
+    single [1, S] softmax)."""
+    pos = pos_ref[0]
+
+    def one(i, _):
+        q = q_ref[i]  # [1, dh]
+        k = k_ref[i // ratio]  # [S, dh]
+        v = v_ref[i // ratio]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [1, S]
+        kp = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kp <= pos, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[i] = (o / l).astype(o_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, h, one, 0)
+
+
+def _fused_decode_attention(q, keys, values, pos):
+    """q ``[B, 1, H, dh]`` (activation layout), keys/values
+    ``[B, H_kv, S, dh]`` (the head-major cache buffers), ``pos`` scalar
+    int32 → ``[B, 1, H, dh]``. GQA reads each K/V head once per query
+    group straight from the grouped layout.
+
+    Grid is (batch,): one step DMAs the row's whole [H_kv, S, dh] K/V
+    (contiguous) and loops heads in-kernel. Measured against the
+    per-(b, h) grid on v5e at GPT-2 124M shapes: 1536 tiny grid steps
+    paid ~10 µs each at batch 128 (27.3 ms/step vs XLA's 18.0); one step
+    per row with 12 in-kernel heads amortizes the grid overhead into
+    DMA-sized work items.
+    """
+    b, s_q, h, dh = q.shape
+    h_kv, s_len = keys.shape[1], keys.shape[2]
+    if s_q != 1:
+        raise NotImplementedError("fused decode attention is single-token")
+    if b > FUSED_MAX_BATCH:
+        raise NotImplementedError(
+            f"batch {b} > {FUSED_MAX_BATCH}: above the measured crossover "
+            "the dense path's batched GEMMs win — dispatcher falls back"
+        )
+    if h % h_kv:
+        raise NotImplementedError(f"q heads {h} not a multiple of kv {h_kv}")
+    ratio = h // h_kv
+    sm_scale = 1.0 / float(np.sqrt(dh))
+    # [B,1,H,dh] -> [B,H,1,dh] moves a singleton: a free reshape, no copy
+    qt = q.reshape(b, h, 1, dh)
+    # None squeezes the batch dim out of the kernel refs, so the blocks
+    # keep their trailing [.., S|1, dh] dims whole — Mosaic-tileable
+    q_spec = pl.BlockSpec((None, h, 1, dh), lambda b, *_: (b, 0, 0, 0))
+    kv_spec = pl.BlockSpec((None, h_kv, s_len, dh), lambda b, *_: (b, 0, 0, 0))
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, sm_scale=sm_scale, h=h, ratio=ratio
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b,),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(jnp.asarray(pos, jnp.int32).reshape(1), qt, keys, values)
+    return out.reshape(b, s_q, h, dh)
+
+
+def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused"):
+    """Single-token attention over the cache buffers from :func:`cached_kv`
+    (``q`` in activation layout ``[B, s, H, dh]``, keys/values head-major
+    ``[B, H_kv, max_len, dh]``).
+
+    ``impl="fused"`` runs the one-launch Pallas kernel (falling back to the
+    dense path when its constraints don't hold — multi-token chunks,
+    ragged head ratios); ``impl="xla"`` is the dense oracle the fused
+    kernel is tested against. Both implement the same function: attention
+    over slots ``<= pos`` (+ row offset for multi-token chunks, via
+    ``mask``).
+    """
+    # explicit applicability predicate, not try/except NotImplementedError:
+    # Pallas itself raises NotImplementedError for unsupported op/platform
+    # combinations, and swallowing those would silently run the dense path
+    # while the bench/docs claim the fused kernel. The VMEM bound: one
+    # grid step stages a row's whole [H_kv, S, dh] K and V panels (double-
+    # buffered by the pipeline), so large-cache geometries (e.g. h_kv=8,
+    # S=8192, dh=128 bf16 = 32 MB K+V) must take the dense path instead
+    # of failing Mosaic's VMEM check at compile time.
+    kv_panel_bytes = (
+        2 * keys.shape[1] * keys.shape[2] * keys.shape[3] * keys.dtype.itemsize
+    )
+    fused_ok = (
+        q.shape[1] == 1
+        and q.shape[0] <= FUSED_MAX_BATCH
+        and q.shape[2] % keys.shape[1] == 0
+        and kv_panel_bytes <= 6 * 1024 * 1024  # ×2 pipeline buffers ≤ ~12 MB
+    )
+    if impl == "fused" and fused_ok:
+        return _fused_decode_attention(q, keys, values, pos)
+    if keys.shape[1] != q.shape[2]:
+        from tpudist.ops.attention import repeat_kv
+
+        # head_axis=1: the cache is head-major (one home for the ratio math)
+        keys, values = repeat_kv(q, keys, values, head_axis=1)
+    # dense oracle over the head-major cache: f32 scores, slot mask, softmax
+    logits = jnp.einsum(
+        "bqhd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
+    ) / np.sqrt(q.shape[-1]).astype(np.float32)
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bqhd", probs, values)
