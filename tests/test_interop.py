@@ -244,6 +244,114 @@ def test_llama_logits_match_transformers(kv_heads, tied):
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
 
 
+def _tiny_t5_config():
+    return transformers.T5Config(
+        vocab_size=48, d_model=32, d_kv=8, num_heads=4, d_ff=64,
+        num_layers=2, num_decoder_layers=2, feed_forward_proj="gated-gelu",
+        relative_attention_num_buckets=8, relative_attention_max_distance=20,
+        tie_word_embeddings=False, dropout_rate=0.0,
+    )
+
+
+def _tiny_t5_model():
+    from tpudist.models.t5 import T5
+
+    return T5(vocab_size=48, hidden_dim=32, ffn_dim=64, enc_depth=2,
+              dec_depth=2, num_heads=4, rel_buckets=8, rel_max_distance=20)
+
+
+def test_t5_logits_match_transformers():
+    """Import direction of the T5 numerics oracle: an HF v1.1-convention
+    model's weights through t5_params_from_hf reproduce transformers'
+    seq2seq logits — pinning the relative-bucket function, un-scaled
+    scores, gated-gelu flavor, RMSNorm placement, and un-tied head."""
+    from tpudist.interop import t5_params_from_hf
+
+    torch.manual_seed(4)
+    hf = transformers.T5ForConditionalGeneration(_tiny_t5_config()).eval()
+    enc = _tokens(b=2, s=12, vocab=48, seed=11)
+    dec = _tokens(b=2, s=8, vocab=48, seed=12)
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(enc.astype(np.int64)),
+            decoder_input_ids=torch.from_numpy(dec.astype(np.int64)),
+        ).logits.numpy()
+
+    params = t5_params_from_hf(
+        hf.state_dict(), enc_depth=2, dec_depth=2, num_heads=4
+    )
+    got = _tiny_t5_model().apply(
+        {"params": params}, jnp.asarray(enc), jnp.asarray(dec), train=False
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_t5_param_tree_matches_model_init():
+    import jax
+    from flax import linen as nn
+
+    from tpudist.interop import t5_params_from_hf
+
+    torch.manual_seed(5)
+    hf = transformers.T5ForConditionalGeneration(_tiny_t5_config())
+    params = t5_params_from_hf(
+        hf.state_dict(), enc_depth=2, dec_depth=2, num_heads=4
+    )
+    model = _tiny_t5_model()
+    want = nn.meta.unbox(
+        model.init(
+            jax.random.key(0),
+            (jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 6), jnp.int32)),
+            train=False,
+        )["params"]
+    )
+    got_paths = {jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_flatten_with_path(params)[0]}
+    want_paths = {jax.tree_util.keystr(p) for p, _ in
+                  jax.tree_util.tree_flatten_with_path(want)[0]}
+    assert got_paths == want_paths
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(want)[0],
+    ):
+        assert np.shape(a) == np.shape(b), (pa, np.shape(a), np.shape(b))
+
+
+def test_t5_export_roundtrips_into_transformers():
+    """Export direction: our randomly-initialized T5 through
+    t5_params_to_hf loads into transformers and reproduces our logits."""
+    import jax
+    from flax import linen as nn
+
+    from tpudist.interop import t5_params_to_hf
+
+    model = _tiny_t5_model()
+    enc = _tokens(b=2, s=12, vocab=48, seed=13)
+    dec = _tokens(b=2, s=8, vocab=48, seed=14)
+    params = nn.meta.unbox(
+        model.init(
+            jax.random.key(9), (jnp.asarray(enc), jnp.asarray(dec)),
+            train=False,
+        )["params"]
+    )
+    ours = np.asarray(
+        model.apply({"params": params}, jnp.asarray(enc), jnp.asarray(dec),
+                    train=False)
+    )
+
+    hf = transformers.T5ForConditionalGeneration(_tiny_t5_config()).eval()
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in
+          t5_params_to_hf(params, enc_depth=2, dec_depth=2).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+    with torch.no_grad():
+        theirs = hf(
+            input_ids=torch.from_numpy(enc.astype(np.int64)),
+            decoder_input_ids=torch.from_numpy(dec.astype(np.int64)),
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
 def test_bert_logits_match_transformers():
     from tpudist.interop import bert_params_from_hf
     from tpudist.models.bert import Bert

@@ -133,4 +133,12 @@ class TokenWindowLoader(SampledLoader):
 
     def _gather_batch(self, idx: np.ndarray, start: int) -> dict:
         batch = self.gather(idx)
-        return self.transform(batch) if self.transform is not None else batch
+        if self.transform is None:
+            return batch
+        if getattr(self.transform, "wants_position", False):
+            # position-keyed objective transforms (T5 span corruption):
+            # (epoch, start) key the randomness, so every epoch draws
+            # fresh corruptions AND a mid-epoch resume (iter_from passes
+            # the true start) replays the original run's stream exactly
+            return self.transform(batch, self.sampler.epoch, start)
+        return self.transform(batch)

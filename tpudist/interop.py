@@ -3,9 +3,10 @@
 The reference trains from random init only (SURVEY.md §5: no persistence,
 /root/reference/main.py:40), but a framework its users switch to needs to
 ingest the ecosystem's pretrained weights. These converters map a GPT-2 /
-Llama ``state_dict`` (any mapping of name → array; torch tensors work via
-``numpy()``) onto the exact parameter trees of
-:class:`tpudist.models.gpt2.GPT2` and :class:`tpudist.models.llama.Llama`.
+Llama / BERT / T5 ``state_dict`` (any mapping of name → array; torch
+tensors work via ``numpy()``) onto the exact parameter trees of the
+corresponding :mod:`tpudist.models` classes — every model family carries
+the same from/to-HF contract.
 
 They double as an external correctness oracle: the test suite builds tiny
 randomly-initialized HF models (no network), converts their weights, and
@@ -297,6 +298,136 @@ def bert_params_to_hf(params, *, depth: int) -> dict:
     return sd
 
 
+def t5_params_from_hf(
+    state_dict, *, enc_depth: int, dec_depth: int, num_heads: int,
+) -> dict:
+    """HF ``T5ForConditionalGeneration`` (v1.1 conventions: gated-gelu,
+    untied lm_head) state dict → :class:`tpudist.models.t5.T5` params.
+
+    Linears are ``nn.Linear`` ([out, in] — transpose); the shared relative
+    position bias lives on block 0 in HF and as the stack-level
+    ``enc_rel_bias``/``dec_rel_bias`` params here (the same sharing, two
+    spellings). Encoder/decoder embeddings are the tied ``shared.weight``.
+    """
+    sd = state_dict
+    wte = _np(sd["shared.weight"])
+    d = wte.shape[1]
+    h = num_heads
+    inner = _np(sd["encoder.block.0.layer.0.SelfAttention.q.weight"]).shape[0]
+    dh = inner // h
+
+    def lin(key, out_shape):
+        return {"kernel": _np(sd[key]).T.reshape(out_shape)}
+
+    def attn(prefix):
+        return {
+            "q": lin(f"{prefix}.q.weight", (d, h, dh)),
+            "k": lin(f"{prefix}.k.weight", (d, h, dh)),
+            "v": lin(f"{prefix}.v.weight", (d, h, dh)),
+            "out": {
+                "kernel": _np(sd[f"{prefix}.o.weight"]).T.reshape(h, dh, d)
+            },
+        }
+
+    def mlp(prefix):
+        return {
+            "wi_0": {"kernel": _np(sd[f"{prefix}.wi_0.weight"]).T},
+            "wi_1": {"kernel": _np(sd[f"{prefix}.wi_1.weight"]).T},
+            "wo": {"kernel": _np(sd[f"{prefix}.wo.weight"]).T},
+        }
+
+    def scale(key):
+        return {"scale": _np(sd[key])}
+
+    params = {
+        "wte": wte,
+        "enc_rel_bias": _np(
+            sd["encoder.block.0.layer.0.SelfAttention"
+               ".relative_attention_bias.weight"]
+        ),
+        "dec_rel_bias": _np(
+            sd["decoder.block.0.layer.0.SelfAttention"
+               ".relative_attention_bias.weight"]
+        ),
+        "ln_enc": scale("encoder.final_layer_norm.weight"),
+        "ln_dec": scale("decoder.final_layer_norm.weight"),
+        "lm_head": {"kernel": _np(sd["lm_head.weight"]).T},
+    }
+    for i in range(enc_depth):
+        p = f"encoder.block.{i}"
+        params[f"enc_{i}"] = {
+            "ln_attn": scale(f"{p}.layer.0.layer_norm.weight"),
+            "attn": attn(f"{p}.layer.0.SelfAttention"),
+            "ln_mlp": scale(f"{p}.layer.1.layer_norm.weight"),
+            "mlp": mlp(f"{p}.layer.1.DenseReluDense"),
+        }
+    for i in range(dec_depth):
+        p = f"decoder.block.{i}"
+        params[f"dec_{i}"] = {
+            "ln_self": scale(f"{p}.layer.0.layer_norm.weight"),
+            "self_attn": attn(f"{p}.layer.0.SelfAttention"),
+            "ln_cross": scale(f"{p}.layer.1.layer_norm.weight"),
+            "cross_attn": attn(f"{p}.layer.1.EncDecAttention"),
+            "ln_mlp": scale(f"{p}.layer.2.layer_norm.weight"),
+            "mlp": mlp(f"{p}.layer.2.DenseReluDense"),
+        }
+    return params
+
+
+def t5_params_to_hf(params, *, enc_depth: int, dec_depth: int) -> dict:
+    """Inverse of :func:`t5_params_from_hf`: ``T5`` params → a state dict
+    loadable by HF ``T5ForConditionalGeneration.load_state_dict`` on a
+    matching v1.1 config (``feed_forward_proj="gated-gelu"``,
+    ``tie_word_embeddings=False``)."""
+    from flax import linen as nn
+
+    p = nn.meta.unbox(params)
+    wte = _np(p["wte"])
+    d = wte.shape[1]
+
+    sd = {
+        "shared.weight": wte,
+        "encoder.embed_tokens.weight": wte,
+        "decoder.embed_tokens.weight": wte,
+        "encoder.block.0.layer.0.SelfAttention"
+        ".relative_attention_bias.weight": _np(p["enc_rel_bias"]),
+        "decoder.block.0.layer.0.SelfAttention"
+        ".relative_attention_bias.weight": _np(p["dec_rel_bias"]),
+        "encoder.final_layer_norm.weight": _np(p["ln_enc"]["scale"]),
+        "decoder.final_layer_norm.weight": _np(p["ln_dec"]["scale"]),
+        "lm_head.weight": _np(p["lm_head"]["kernel"]).T,
+    }
+
+    def put_attn(prefix, blk):
+        sd[f"{prefix}.q.weight"] = _np(blk["q"]["kernel"]).reshape(d, -1).T
+        sd[f"{prefix}.k.weight"] = _np(blk["k"]["kernel"]).reshape(d, -1).T
+        sd[f"{prefix}.v.weight"] = _np(blk["v"]["kernel"]).reshape(d, -1).T
+        sd[f"{prefix}.o.weight"] = _np(blk["out"]["kernel"]).reshape(-1, d).T
+
+    def put_mlp(prefix, blk):
+        sd[f"{prefix}.wi_0.weight"] = _np(blk["wi_0"]["kernel"]).T
+        sd[f"{prefix}.wi_1.weight"] = _np(blk["wi_1"]["kernel"]).T
+        sd[f"{prefix}.wo.weight"] = _np(blk["wo"]["kernel"]).T
+
+    for i in range(enc_depth):
+        blk = p[f"enc_{i}"]
+        o = f"encoder.block.{i}"
+        sd[f"{o}.layer.0.layer_norm.weight"] = _np(blk["ln_attn"]["scale"])
+        put_attn(f"{o}.layer.0.SelfAttention", blk["attn"])
+        sd[f"{o}.layer.1.layer_norm.weight"] = _np(blk["ln_mlp"]["scale"])
+        put_mlp(f"{o}.layer.1.DenseReluDense", blk["mlp"])
+    for i in range(dec_depth):
+        blk = p[f"dec_{i}"]
+        o = f"decoder.block.{i}"
+        sd[f"{o}.layer.0.layer_norm.weight"] = _np(blk["ln_self"]["scale"])
+        put_attn(f"{o}.layer.0.SelfAttention", blk["self_attn"])
+        sd[f"{o}.layer.1.layer_norm.weight"] = _np(blk["ln_cross"]["scale"])
+        put_attn(f"{o}.layer.1.EncDecAttention", blk["cross_attn"])
+        sd[f"{o}.layer.2.layer_norm.weight"] = _np(blk["ln_mlp"]["scale"])
+        put_mlp(f"{o}.layer.2.DenseReluDense", blk["mlp"])
+    return sd
+
+
 def load_hf_params(
     path, *, arch: str, depth: int, num_heads: int,
     num_kv_heads: int | None = None,
@@ -312,7 +443,13 @@ def load_hf_params(
         )
     if arch == "bert":
         return bert_params_from_hf(sd, depth=depth, num_heads=num_heads)
-    raise ValueError(f"unknown arch {arch!r} (want gpt2, llama, or bert)")
+    if arch == "t5":
+        # symmetric stacks (the published t5/v1.1 geometries); call
+        # t5_params_from_hf directly for asymmetric enc/dec depths
+        return t5_params_from_hf(
+            sd, enc_depth=depth, dec_depth=depth, num_heads=num_heads
+        )
+    raise ValueError(f"unknown arch {arch!r} (want gpt2, llama, bert, or t5)")
 
 
 def save_hf_checkpoint(params, path, *, arch: str, depth: int) -> None:
@@ -330,8 +467,10 @@ def save_hf_checkpoint(params, path, *, arch: str, depth: int) -> None:
         sd = llama_params_to_hf(params, depth=depth)
     elif arch == "bert":
         sd = bert_params_to_hf(params, depth=depth)
+    elif arch == "t5":
+        sd = t5_params_to_hf(params, enc_depth=depth, dec_depth=depth)
     else:
-        raise ValueError(f"unknown arch {arch!r} (want gpt2, llama, or bert)")
+        raise ValueError(f"unknown arch {arch!r} (want gpt2, llama, bert, or t5)")
     os.makedirs(path, exist_ok=True)
     save_file(
         {k: np.ascontiguousarray(v) for k, v in sd.items()},

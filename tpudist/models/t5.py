@@ -127,7 +127,9 @@ class _GatedMlp(nn.Module):
             self.ffn_dim, dtype=self.dtype, use_bias=False, name=name,
             kernel_init=_partitioned(init, None, TENSOR_AXIS),
         )
-        y = nn.gelu(col("wi_0")(x), approximate=False) * col("wi_1")(x)
+        # tanh-approximate gelu = the published T5 v1.1 "gated-gelu"
+        # (transformers' gelu_new) — keeps HF interop numerics exact
+        y = nn.gelu(col("wi_0")(x), approximate=True) * col("wi_1")(x)
         return nn.Dense(
             d, dtype=self.dtype, use_bias=False, name="wo",
             kernel_init=_partitioned(init, TENSOR_AXIS, None),
@@ -280,11 +282,33 @@ def span_corrupt_transform(
     shifted right behind ``start_id``. Fixed counts → fixed shapes → no
     padding, no masks. Produces ``{"enc_tokens", "dec_tokens",
     "targets"}``; data vocab ids must stay below the sentinel/EOS range.
-    """
-    rng = np.random.Generator(np.random.PCG64(seed))
 
-    def run(batch):
+    The corruption stream follows the framework's (seed, epoch, position)
+    keying: the transform declares ``wants_position``, so position-aware
+    loaders (``TokenWindowLoader``) pass ``(epoch, start)`` and the
+    per-batch RNG is a pure function of ``(seed, epoch, start)`` — every
+    epoch draws FRESH corruptions for the same window, and a mid-epoch
+    checkpoint resume (``fit(resume=True)`` + ``iter_from``, which passes
+    the true start) replays exactly the corruptions of the original run.
+    A foreign loader that calls the transform without position falls back
+    to keying on a digest of the batch's tokens — still deterministic and
+    resume-stable, but then identical repeated batches repeat their
+    corruption (no epoch freshness); use a position-aware loader for
+    multi-epoch training.
+    """
+    import zlib
+
+    def run(batch, epoch=None, start=None):
         tokens = np.asarray(batch[key])
+        if epoch is not None:
+            entropy = [seed, int(epoch), int(start)]
+        else:
+            entropy = [
+                seed, zlib.crc32(np.ascontiguousarray(tokens).tobytes())
+            ]
+        rng = np.random.Generator(np.random.PCG64(
+            np.random.SeedSequence(entropy)
+        ))
         b, length = tokens.shape
         noise, spans, enc_len, dec_len = span_corruption_plan(
             length, density=density, mean_span=mean_span
@@ -325,6 +349,7 @@ def span_corrupt_transform(
         out["targets"] = tgt
         return out
 
+    run.wants_position = True
     return run
 
 
