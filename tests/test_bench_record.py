@@ -1,0 +1,84 @@
+"""The bench record contract: every metric line a leg emits is appended to
+the shared record file, and the parent's final ``bench_summary`` line carries
+EVERY leg's value — so a tail-truncated stdout capture (how the round driver
+records bench output; round 4 lost its three vision metrics to it) still
+holds the whole round. No device work: this exercises only the JSON-line
+plumbing in bench.py.
+"""
+
+import contextlib
+import importlib.util
+import io
+import json
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    record = tmp_path / "record.jsonl"
+    record.touch()
+    monkeypatch.setenv(mod._RECORD_ENV, str(record))
+    mod._test_record_path = str(record)
+    return mod
+
+
+def test_emit_appends_to_record_file(bench, capsys):
+    bench._emit("m1", 100.0, "u", 50.0)
+    bench._record_line(
+        {"metric": "m2", "value": 2.0, "unit": "u2", "vs_baseline": 0.5}
+    )
+    # stdout contract unchanged: one JSON object per line
+    lines = [json.loads(s) for s in capsys.readouterr().out.strip().splitlines()]
+    assert [o["metric"] for o in lines] == ["m1", "m2"]
+    assert lines[0]["vs_baseline"] == 2.0
+    # and the same lines landed in the record file
+    rec = [
+        json.loads(s)
+        for s in pathlib.Path(bench._test_record_path).read_text().splitlines()
+    ]
+    assert rec == lines
+
+
+def test_summary_carries_every_leg(bench, tmp_path, capsys):
+    bench._emit("resnet50_train_images_per_sec_per_chip", 2560.0, "img/s", 2250.0)
+    bench._emit("gpt2_124m_tokens_per_sec_per_chip", 126000.0, "tok/s", 50000.0)
+    capsys.readouterr()
+    bench._emit_summary(
+        bench._test_record_path, {"resnet": True, "gpt2": False},
+        out_path=str(tmp_path / "BENCH_SUMMARY.json"),
+    )
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["metric"] == "bench_summary"
+    assert set(summary["legs"]) == {
+        "resnet50_train_images_per_sec_per_chip",
+        "gpt2_124m_tokens_per_sec_per_chip",
+    }
+    # vs_baseline is the headline leg's ratio
+    assert summary["vs_baseline"] == pytest.approx(2560.0 / 2250.0, rel=1e-3)
+    assert summary["failed_leg_groups"] == ["gpt2"]
+    on_disk = json.loads((tmp_path / "BENCH_SUMMARY.json").read_text())
+    assert on_disk["legs"] == summary["legs"]
+
+
+def test_summary_survives_corrupt_lines(bench, capsys, tmp_path):
+    record_path = bench._test_record_path
+    with open(record_path, "a") as f:
+        f.write('{"metric": "ok_leg", "value": 1.0, "unit": "u", '
+                '"vs_baseline": 1.0}\n')
+        f.write("{truncated json\n")  # a SIGKILL'd child mid-write
+    with contextlib.redirect_stdout(io.StringIO()) as buf:
+        # out_path into tmp: the default writes next to bench.py, which
+        # would clobber a real round's BENCH_SUMMARY.json
+        bench._emit_summary(
+            record_path, {}, out_path=str(tmp_path / "BENCH_SUMMARY.json")
+        )
+    summary = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert set(summary["legs"]) == {"ok_leg"}
