@@ -42,10 +42,38 @@ if os.environ.get("TPUDIST_NO_JAX_CACHE", "").lower() not in ("1", "true", "yes"
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+# The smoke tier: the fastest high-signal slice of the suite, sized for a
+# COLD 1-core host (no persistent compile cache) to finish well inside a
+# 10-minute budget — `pytest -m smoke`. Selection rule: every reference-
+# parity layer gets at least one file (sampler shard math, metrics
+# contract, mesh/shardings, DP-step equivalence, data paths, native C++
+# round-trips, decode/generation), but compile-heavy model files
+# (bert/t5/vit/pipeline/fsdp/moe/flash) and all subprocess tests stay out.
+# Measured cold on this 1-core host: see README "Testing" for the number
+# recorded at marking time.
+_SMOKE_FILES = {
+    "test_bench_record.py",
+    "test_dp_equivalence.py",
+    "test_generate.py",
+    "test_lm_data.py",
+    "test_lm_loss.py",
+    "test_mesh.py",
+    "test_metrics.py",
+    "test_native.py",
+    "test_packed.py",
+    "test_sampler.py",
+    "test_transforms.py",
+}
+
+
 def pytest_collection_modifyitems(config, items):
     """Tests marked ``subproc_only`` run ONLY inside their wrapper's child
     process (TPUDIST_SUBPROC_TEST=1) — the containment mechanism for the
-    crash-capable ring-collective test (see test_bert.py)."""
+    crash-capable ring-collective test (see test_bert.py). Files in
+    ``_SMOKE_FILES`` are additionally marked ``smoke`` (the cold-budget
+    tier; ``slow``-marked tests inside them stay excluded via
+    ``-m "smoke and not slow"`` semantics — the smoke command selects
+    both)."""
     import pytest as _pytest
 
     if os.environ.get("TPUDIST_SUBPROC_TEST"):
@@ -54,3 +82,5 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "subproc_only" in item.keywords:
             item.add_marker(skip)
+        if item.fspath.basename in _SMOKE_FILES and "slow" not in item.keywords:
+            item.add_marker(_pytest.mark.smoke)
