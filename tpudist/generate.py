@@ -81,6 +81,44 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _sample_scan(decode_step, cache, first_logits, rng, *, max_new_tokens,
+                 temperature, top_k, top_p):
+    """The shared sampling loop of both generation paths: scan
+    ``max_new_tokens`` (sample from the previous position's logits, decode
+    one step) iterations. The final carry's logits go unused — the last
+    decode_step primes a position that is never sampled."""
+
+    def sample_step(carry, _):
+        cache, last_logits, rng = carry
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(
+            last_logits, sub, temperature=temperature, top_k=top_k,
+            top_p=top_p,
+        )
+        cache, next_logits = decode_step(cache, tok)
+        return (cache, next_logits, rng), tok
+
+    (cache, _, _), toks = jax.lax.scan(
+        sample_step, (cache, first_logits, rng), None, length=max_new_tokens
+    )
+    return toks.T  # [B, max_new_tokens]
+
+
+def _fetch_tokens(out) -> np.ndarray:
+    """Generated device tokens → host numpy, multi-process-safe."""
+    if not out.is_fully_addressable:
+        # multi-process with sharded/global params: the jit output may span
+        # hosts, and np.asarray on a non-addressable array raises; every
+        # process runs the same decode on the same prompt, so allgathering
+        # the token ids (tiny) yields the identical [B, T] everywhere.
+        # tiled=True is required for global non-addressable inputs and
+        # returns the global [B, T] (no leading process dim)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(out, tiled=True))
+    return np.asarray(out)
+
+
 def generate(
     model,
     params,
@@ -123,17 +161,87 @@ def generate(
         max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k,
         top_p=top_p,
     )
-    if not out.is_fully_addressable:
-        # multi-process with sharded/global params: the jit output may span
-        # hosts, and np.asarray on a non-addressable array raises; every
-        # process runs the same decode on the same prompt, so allgathering
-        # the token ids (tiny) yields the identical [B, T] everywhere
-        from jax.experimental import multihost_utils
+    return _fetch_tokens(out)
 
-        # tiled=True is required for global non-addressable inputs and
-        # returns the global [B, T] (no leading process dim)
-        return np.asarray(multihost_utils.process_allgather(out, tiled=True))
-    return np.asarray(out)
+
+def generate_seq2seq(
+    model,
+    params,
+    enc_tokens,
+    max_new_tokens: int,
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    seed: int = 0,
+    start_id: int = 0,
+) -> np.ndarray:
+    """Seq2seq generation for encoder-decoder models (T5): encode
+    ``enc_tokens`` ``[B, Se]`` once, then autoregressively decode
+    ``max_new_tokens`` tokens from ``start_id`` against the decoder's KV
+    cache — all (encode + prefill + sampling) as ONE jit-compiled program,
+    the same single-compilation contract as :func:`generate`. Returns
+    ``[B, max_new_tokens]`` int32; same sampling controls as
+    :func:`sample_logits`.
+
+    The model must support the ``encode_only``/``decode`` entry points
+    (:class:`tpudist.models.t5.T5`); the cache buffer is
+    ``model.max_decode_len`` slots (the start token takes one).
+    """
+    enc_tokens = jnp.asarray(enc_tokens, jnp.int32)
+    if max_new_tokens + 1 > model.max_decode_len:
+        raise ValueError(
+            f"start token + {max_new_tokens} new tokens exceeds the "
+            f"model's max_decode_len {model.max_decode_len} (the decoder "
+            "KV cache size)"
+        )
+    out = _run_seq2seq(
+        model, params, enc_tokens, jax.random.key(seed),
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, start_id=start_id,
+    )
+    return _fetch_tokens(out)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "top_p", "start_id"),
+)
+def _run_seq2seq(model, params, enc_tokens, rng, *, max_new_tokens,
+                 temperature, top_k, top_p, start_id):
+    b = enc_tokens.shape[0]
+    enc = model.apply(
+        {"params": params}, enc_tokens, train=False, encode_only=True
+    )
+    # decoder cache shapes from a throwaway init trace (shapes only — the
+    # cache depends on the decoder side alone, so a length-1 dummy enc
+    # keeps the trace cheap)
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
+            train=False, decode=True,
+            enc=jnp.zeros((b, 1, model.hidden_dim), enc.dtype),
+        )
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+
+    def decode_step(cache, tok):
+        logits, updates = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, decode=True, enc=enc, mutable=["cache"],
+        )
+        return updates["cache"], logits[:, -1]
+
+    cache, logits = decode_step(
+        cache, jnp.full((b,), start_id, jnp.int32)
+    )
+    return _sample_scan(
+        decode_step, cache, logits, rng, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )
 
 
 @partial(
@@ -158,18 +266,7 @@ def _run(model, params, cache, prompt, rng, *, max_new_tokens, temperature,
 
     # prefill: feed prompt tokens through the cache, keep the last logits
     cache, logits = jax.lax.scan(decode_step, cache, prompt.T)
-
-    def sample_step(carry, _):
-        cache, last_logits, rng = carry
-        rng, sub = jax.random.split(rng)
-        tok = sample_logits(
-            last_logits, sub, temperature=temperature, top_k=top_k,
-            top_p=top_p,
-        )
-        cache, next_logits = decode_step(cache, tok)
-        return (cache, next_logits, rng), tok
-
-    (cache, _, _), toks = jax.lax.scan(
-        sample_step, (cache, logits[-1], rng), None, length=max_new_tokens
+    return _sample_scan(
+        decode_step, cache, logits[-1], rng, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, top_p=top_p,
     )
-    return toks.T  # [B, max_new_tokens]

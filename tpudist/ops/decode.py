@@ -180,17 +180,23 @@ def _fused_decode_attention(q, keys, values, pos):
     return out.reshape(b, s_q, h, dh)
 
 
-def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused"):
+def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused",
+                     bias=None, scale=None):
     """Single-token attention over the cache buffers from :func:`cached_kv`
     (``q`` in activation layout ``[B, s, H, dh]``, keys/values head-major
     ``[B, H_kv, max_len, dh]``).
 
     ``impl="fused"`` runs the one-launch Pallas kernel (falling back to the
     dense path when its constraints don't hold — multi-token chunks,
-    ragged head ratios); ``impl="xla"`` is the dense oracle the fused
-    kernel is tested against. Both implement the same function: attention
-    over slots ``<= pos`` (+ row offset for multi-token chunks, via
-    ``mask``).
+    ragged head ratios, K/V panels past the VMEM bound); ``impl="xla"``
+    is the dense oracle the fused kernel is tested against. Both implement
+    the same function: attention over slots ``<= pos`` (+ row offset for
+    multi-token chunks, via ``mask``).
+
+    ``bias``: optional additive score bias broadcastable to
+    ``[B, H, s, max_len]`` (T5's relative position bias) — dense path
+    only (the fused kernel takes none). ``scale`` overrides the default
+    ``1/sqrt(dh)`` (T5 uses 1.0).
     """
     # explicit applicability predicate, not try/except NotImplementedError:
     # Pallas itself raises NotImplementedError for unsupported op/platform
@@ -204,7 +210,9 @@ def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused"):
         2 * keys.shape[1] * keys.shape[2] * keys.shape[3] * keys.dtype.itemsize
     )
     fused_ok = (
-        q.shape[1] == 1
+        bias is None
+        and scale is None
+        and q.shape[1] == 1
         and q.shape[0] <= FUSED_MAX_BATCH
         and q.shape[2] % keys.shape[1] == 0
         and kv_panel_bytes <= 6 * 1024 * 1024  # ×2 pipeline buffers ≤ ~12 MB
@@ -217,9 +225,13 @@ def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused"):
         # head_axis=1: the cache is head-major (one home for the ratio math)
         keys, values = repeat_kv(q, keys, values, head_axis=1)
     # dense oracle over the head-major cache: f32 scores, slot mask, softmax
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
     logits = jnp.einsum(
         "bqhd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
-    ) / np.sqrt(q.shape[-1]).astype(np.float32)
+    ) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bqhd", probs, values)
