@@ -161,6 +161,29 @@ def test_t5_incremental_decode_matches_full_forward():
     incremental = np.stack(steps, axis=1)
     np.testing.assert_allclose(incremental, full, atol=2e-4, rtol=2e-4)
 
+    # multi-token CHUNK decode (bulk prefill shape): first 5 tokens in one
+    # pass, remainder stepwise — pins the per-row bias slice and the
+    # causal-within-chunk cache mask
+    cache = model.init(
+        jax.random.key(0), jnp.zeros((2, 1), jnp.int32), train=False,
+        decode=True, enc=jnp.zeros((2, 1, model.hidden_dim), enc_out.dtype),
+    )["cache"]
+    chunk_logits, upd = model.apply(
+        {"params": params, "cache": cache}, dec[:, :5],
+        train=False, decode=True, enc=enc_out, mutable=["cache"],
+    )
+    cache = upd["cache"]
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits), full[:, :5], atol=2e-4, rtol=2e-4
+    )
+    logits, _ = model.apply(
+        {"params": params, "cache": cache}, dec[:, 5:6],
+        train=False, decode=True, enc=enc_out, mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[:, 0]), full[:, 5], atol=2e-4, rtol=2e-4
+    )
+
 
 def test_generate_seq2seq_greedy_matches_full_forward_rollout():
     """Greedy generate_seq2seq equals repeatedly argmaxing the joint

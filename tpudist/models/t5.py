@@ -273,10 +273,12 @@ class T5(nn.Module):
             if initialized:
                 pos_var.value = pos + tok.shape[1]
             # full static [H, Dmax, Dmax] causal bias table (XLA folds the
-            # bucket iota), current row sliced at the traced position
+            # bucket iota); rows pos..pos+s-1 sliced at the traced
+            # position — one row per chunk token, so multi-token chunks
+            # (bulk prefill) see each row's own relative distances
             table = rel_bias("dec_rel_bias", dmax, dmax, False)
             bias = jax.lax.dynamic_slice(
-                table, (0, pos, 0), (self.num_heads, 1, dmax)
+                table, (0, pos, 0), (self.num_heads, tok.shape[1], dmax)
             )
             y = wte[tok].astype(self.dtype)
             for i in range(self.dec_depth):

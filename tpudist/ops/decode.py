@@ -55,8 +55,11 @@ def cached_kv(module, k, v, max_len: int, pre_update=None):
 
     Must be called inside a flax module's ``__call__`` (it creates
     ``cache`` collection variables). ``k``/``v``: ``[B, s, H, dh]`` for the
-    current step (``s`` is 1 during sampling; larger chunks work if the
-    caller masks causality within the chunk — our callers feed 1).
+    current step — ``s`` is 1 during sampling; larger chunks are
+    first-class (the returned mask is causal WITHIN the chunk: slot ``t``
+    attendable by chunk row ``i`` iff ``t <= pos + i``), and
+    ``tpudist.generate``'s bulk prefill relies on exactly that, feeding
+    the whole prompt as one chunk.
 
     ``pre_update(k, v, position) -> (k, v)`` runs before the write with the
     step's absolute position — RoPE models rotate keys here so the cache
