@@ -58,6 +58,8 @@ Legs
 10. ``t5_small_tokens_per_sec_per_chip`` — the encoder-decoder family's
    perf contract: T5 v1.1-small train step on span-corruption shapes;
    vs_baseline = MFU vs the hand FLOP roofline.
+11. ``llama_125m_tokens_per_sec_per_chip`` / ``bert_base_mlm_tokens_per_
+   sec_per_chip`` — the remaining family contracts, same MFU convention.
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -86,6 +88,7 @@ import optax
 
 TARGET_IMG_PER_SEC_PER_CHIP = 2250.0
 TARGET_TOK_PER_SEC_PER_CHIP = 50_000.0
+V5E_BF16_PEAK = 197e12  # one home for the MFU denominators
 
 # Legs run in child processes sharing stdout; each metric line is ALSO
 # appended to this file (path exported by the parent) so the parent can emit
@@ -647,7 +650,7 @@ def bench_gpt2_wide() -> None:
     weight_matmul_params = depth * 12 * hidden * hidden + vocab * hidden
     gemm_tf = 6.0 * t * weight_matmul_params  # fwd + dgrad + wgrad
     attn_tf = depth * 12.0 * t * seq_len * hidden  # 6 matmuls/layer
-    mfu = (gemm_tf + attn_tf) / dt / 197e12
+    mfu = (gemm_tf + attn_tf) / dt / V5E_BF16_PEAK
     _emit_mfu = round(mfu, 4)
     _record_line(
         {
@@ -728,7 +731,7 @@ def bench_t5() -> None:
         + td * dec_len * h * dec_d                 # decoder self
         + td * enc_len * h * dec_d                 # cross
     )
-    mfu = (gemm + attn) / dt / 197e12
+    mfu = (gemm + attn) / dt / V5E_BF16_PEAK
     tok_s = (te + td) / dt
     _record_line(
         {
@@ -743,6 +746,105 @@ def bench_t5() -> None:
             "vs_baseline": round(mfu, 4),
         }
     )
+
+
+def bench_families() -> None:
+    """The remaining model families' perf contracts (GPT-2/ViT/ResNet/T5
+    have theirs): Llama-125M (RoPE, RMSNorm, SwiGLU, GQA 12/4) and
+    BERT-base MLM train steps, each vs the hand-model FLOP roofline
+    (fwd + 2x bwd GEMMs + attention; vs_baseline = MFU)."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.bert import Bert, mlm_forward, mlm_transform
+    from tpudist.models.llama import llama_125m
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    rng = np.random.Generator(np.random.PCG64(0))
+    n_steps = 20
+
+    def drive(model_name, state, step, batches, tokens_per_step, flops,
+              config_note):
+        for _ in range(3):
+            state, metrics = step(state, next(batches))
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, next(batches))
+        float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / n_steps
+        mfu = flops / dt / V5E_BF16_PEAK
+        _record_line(
+            {
+                "metric": f"{model_name}_tokens_per_sec_per_chip",
+                "value": round(tokens_per_step / dt / n_chips, 2),
+                "unit": f"tokens/sec/chip ({config_note}); measured MFU "
+                f"{round(mfu, 4)} of v5e bf16 peak (hand FLOP model); "
+                "vs_baseline = MFU (fraction of the FLOP roofline)",
+                "vs_baseline": round(mfu, 4),
+            }
+        )
+
+    # -- Llama 125M: seq 1024, 8x4 accum, vmem kernel, GQA 12/4 ----------
+    seq, vocab, d, depth, ffn, kv_heads = 1024, 32000, 768, 12, 2048, 4
+    micro, accum = 8, 4
+    seqs = micro * accum * n_chips
+    model = llama_125m(
+        vocab_size=vocab, dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh,
+        ffn_dim=ffn, max_seq_len=seq,
+    )
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+    )
+    # chunked CE A/B'd on v5e at this config: 142.1k tok/s chunk-512 vs
+    # 150.0k unchunked — at vocab 32k and micro-batch 8 the full fp32
+    # logits (~1 GB) fit comfortably and the chunk scan's bookkeeping
+    # costs more than the bytes it saves (GPT-2's 50k-vocab sweep went
+    # the other way; the crossover is vocab×batch). The leg runs the
+    # measured-faster unchunked head.
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", grad_accum=accum,
+    )
+    batches = iter([
+        {"tokens": rng.integers(0, vocab, (seqs, seq)).astype(np.int32)}
+        for _ in range(n_steps + 3)
+    ])
+    t = seqs * seq / n_chips
+    dh = d // 12
+    layer_p = 2 * d * d + 2 * d * (kv_heads * dh) + 3 * d * ffn
+    flops = 6.0 * t * (depth * layer_p + vocab * d) + depth * 12.0 * t * seq * d
+    drive("llama_125m", state, step, batches, seqs * seq, flops,
+          "Llama-125M: RoPE/RMSNorm/SwiGLU, GQA 12/4, bf16, seq 1024, "
+          "8x4-accum/chip, vmem attention")
+
+    # -- BERT-base MLM: seq 512, batch 32/chip, vmem kernel ---------------
+    bvocab, bseq, bbatch = 30522, 512, 32 * n_chips
+    bmodel = Bert(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
+    bstate = create_train_state(
+        bmodel, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+    )
+    corrupt = mlm_transform(bvocab, mask_id=103, seed=0)
+    bstep = make_train_step(
+        bmodel, tx, mesh, input_key="tokens", label_key="targets",
+        forward_loss=mlm_forward(bmodel, chunk=512),
+    )
+    bbatches = iter([
+        corrupt({"tokens": rng.integers(
+            999, bvocab, (bbatch, bseq)).astype(np.int32)})
+        for _ in range(n_steps + 3)
+    ])
+    bt = bbatch * bseq / n_chips
+    bd = bmodel.hidden_dim
+    # block GEMMs 12·d² per layer + MLM head (d² transform + tied V·d)
+    bflops = (
+        6.0 * bt * (12 * 12 * bd * bd + bd * bd + bvocab * bd)
+        + 12 * 12.0 * bt * bseq * bd
+    )
+    drive("bert_base_mlm", bstate, bstep, bbatches, bbatch * bseq, bflops,
+          "BERT-base MLM (80/10/10 corruption), bf16, seq 512, batch "
+          "32/chip, vmem attention, chunked MLM head")
 
 
 def bench_decode() -> None:
@@ -893,6 +995,7 @@ _LEG_GROUPS = {
     "long_context": (bench_gpt2_long_context, 1800),
     "wide": (bench_gpt2_wide, 1800),
     "t5": (bench_t5, 1800),
+    "families": (bench_families, 1800),
     "decode": (bench_decode, 1800),  # +300s: the batch-128 serving leg
 }
 

@@ -216,6 +216,29 @@ def test_sampler_topk_topp_threshold_equals_full_sort():
             assert (r, int(t)) in seen, (r, int(t), ref_probs[r, t])
 
 
+def test_generate_eos_pads_the_tail():
+    """With eos_id set, each row emits pad_id after its first EOS and the
+    pre-EOS prefix is unchanged from the unconstrained run (greedy —
+    deterministic, so the two runs are comparable token-for-token)."""
+    model = GPT2(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=1,
+                 num_heads=4)
+    prompt = _tokens(b=3, s=4, seed=21)
+    params = model.init(jax.random.key(21), prompt, train=False)["params"]
+    free = generate(model, params, prompt, 10, temperature=0.0)
+    # pick an eos id that actually occurs mid-sequence in some row
+    eos = int(free[0, 4])
+    out = generate(model, params, prompt, 10, temperature=0.0, eos_id=eos,
+                   pad_id=63)
+    for r in range(free.shape[0]):
+        hits = np.nonzero(free[r] == eos)[0]
+        if hits.size == 0:
+            np.testing.assert_array_equal(out[r], free[r])
+        else:
+            cut = hits[0]
+            np.testing.assert_array_equal(out[r, :cut + 1], free[r, :cut + 1])
+            assert (out[r, cut + 1:] == 63).all()
+
+
 def test_generate_with_tensor_sharded_params():
     """Decode composes with tensor parallelism: Megatron-sharded params on
     a data x tensor mesh generate the same tokens as replicated params."""

@@ -83,24 +83,36 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
 
 
 def _sample_scan(decode_step, cache, first_logits, rng, *, max_new_tokens,
-                 temperature, top_k, top_p):
+                 temperature, top_k, top_p, eos_id=None, pad_id=0):
     """The shared sampling loop of both generation paths: scan
     ``max_new_tokens`` (sample from the previous position's logits, decode
     one step) iterations. The final carry's logits go unused — the last
-    decode_step primes a position that is never sampled."""
+    decode_step primes a position that is never sampled.
+
+    ``eos_id``: rows that have emitted it produce ``pad_id`` from then on
+    (the sequence stays static-shaped — the TPU way to "stop"; the cache
+    keeps advancing, which is harmless since padded positions are never
+    read back). The scan always runs ``max_new_tokens`` steps: a
+    data-dependent early exit would force a ``while_loop`` that defeats
+    the fixed-shape single compilation."""
 
     def sample_step(carry, _):
-        cache, last_logits, rng = carry
+        cache, last_logits, rng, done = carry
         rng, sub = jax.random.split(rng)
         tok = sample_logits(
             last_logits, sub, temperature=temperature, top_k=top_k,
             top_p=top_p,
         )
+        if eos_id is not None:
+            tok = jnp.where(done, pad_id, tok)
+            done = done | (tok == eos_id)
         cache, next_logits = decode_step(cache, tok)
-        return (cache, next_logits, rng), tok
+        return (cache, next_logits, rng, done), tok
 
-    (cache, _, _), toks = jax.lax.scan(
-        sample_step, (cache, first_logits, rng), None, length=max_new_tokens
+    done0 = jnp.zeros(first_logits.shape[0], bool)
+    (cache, _, _, _), toks = jax.lax.scan(
+        sample_step, (cache, first_logits, rng, done0), None,
+        length=max_new_tokens,
     )
     return toks.T  # [B, max_new_tokens]
 
@@ -140,13 +152,17 @@ def generate(
     top_k: int | None = None,
     top_p: float | None = None,
     seed: int = 0,
+    eos_id: int | None = None,
+    pad_id: int = 0,
 ) -> np.ndarray:
     """Continue ``prompt`` (``[B, P]`` int tokens) by ``max_new_tokens``.
 
     Works for any model with the decode contract (``decode=True`` +
     ``cache`` collection): GPT-2 and Llama. Returns ``[B, max_new_tokens]``
     int32. Greedy when ``temperature=0``, else temperature/top-k/top-p
-    (nucleus) sampling.
+    (nucleus) sampling. With ``eos_id``, rows that emit it produce
+    ``pad_id`` thereafter (static shapes — the compiled program always
+    runs ``max_new_tokens`` steps).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
@@ -165,7 +181,7 @@ def generate(
     out = _run(
         model, params, cache, prompt, jax.random.key(seed),
         max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k,
-        top_p=top_p,
+        top_p=top_p, eos_id=eos_id, pad_id=pad_id,
     )
     return _fetch_tokens(out)
 
@@ -181,6 +197,8 @@ def generate_seq2seq(
     top_p: float | None = None,
     seed: int = 0,
     start_id: int = 0,
+    eos_id: int | None = None,
+    pad_id: int = 0,
 ) -> np.ndarray:
     """Seq2seq generation for encoder-decoder models (T5): encode
     ``enc_tokens`` ``[B, Se]`` once, then autoregressively decode
@@ -193,6 +211,8 @@ def generate_seq2seq(
     The model must support the ``encode_only``/``decode`` entry points
     (:class:`tpudist.models.t5.T5`); the cache buffer is
     ``model.max_decode_len`` slots (the start token takes one).
+    ``eos_id`` (T5's natural stop: its EOS ends the span-target sequence)
+    pads each row with ``pad_id`` after its first EOS.
     """
     enc_tokens = jnp.asarray(enc_tokens, jnp.int32)
     if max_new_tokens + 1 > model.max_decode_len:
@@ -204,7 +224,8 @@ def generate_seq2seq(
     out = _run_seq2seq(
         model, params, enc_tokens, jax.random.key(seed),
         max_new_tokens=max_new_tokens, temperature=temperature,
-        top_k=top_k, top_p=top_p, start_id=start_id,
+        top_k=top_k, top_p=top_p, start_id=start_id, eos_id=eos_id,
+        pad_id=pad_id,
     )
     return _fetch_tokens(out)
 
@@ -212,10 +233,10 @@ def generate_seq2seq(
 @partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "top_p", "start_id"),
+                     "top_p", "start_id", "eos_id", "pad_id"),
 )
 def _run_seq2seq(model, params, enc_tokens, rng, *, max_new_tokens,
-                 temperature, top_k, top_p, start_id):
+                 temperature, top_k, top_p, start_id, eos_id, pad_id):
     b = enc_tokens.shape[0]
     enc = model.apply(
         {"params": params}, enc_tokens, train=False, encode_only=True
@@ -242,17 +263,18 @@ def _run_seq2seq(model, params, enc_tokens, rng, *, max_new_tokens,
     )
     return _sample_scan(
         decode_step, cache, logits, rng, max_new_tokens=max_new_tokens,
-        temperature=temperature, top_k=top_k, top_p=top_p,
+        temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+        pad_id=pad_id,
     )
 
 
 @partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "top_p"),
+                     "top_p", "eos_id", "pad_id"),
 )
 def _run(model, params, cache, prompt, rng, *, max_new_tokens, temperature,
-         top_k, top_p):
+         top_k, top_p, eos_id, pad_id):
     """One compiled program for prefill + sampling. ``params`` is a traced
     argument (not a closure constant), and jit caches on the static
     (model, length, sampling) config — repeated generate() calls with the
@@ -280,5 +302,6 @@ def _run(model, params, cache, prompt, rng, *, max_new_tokens, temperature,
     cache, logits = decode_chunk(cache, prompt)
     return _sample_scan(
         decode_step, cache, logits, rng, max_new_tokens=max_new_tokens,
-        temperature=temperature, top_k=top_k, top_p=top_p,
+        temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+        pad_id=pad_id,
     )
