@@ -282,7 +282,10 @@ class RotatingDeviceCache:
     is replicated, like :class:`DeviceCachedLoader`'s), and per batch each
     process contributes its rank's stride of the global within-shard
     order — the DistributedSampler disjointness contract at the batch
-    level.
+    level. Staging OVERLAP is single-process only: multi-process runs
+    stage inline at shard boundaries (no extra device-work-issuing
+    thread — the measured deadlock and the threading-shape reasoning are
+    in ``_iter_impl``).
     """
 
     def __init__(
@@ -371,14 +374,26 @@ class RotatingDeviceCache:
 
     def _stage(self, shard_global_rows: np.ndarray):
         """Gather one shard's pixels from the (mem-mapped) source and put
-        them on device (chunked — transport-hang guard); runs on the
-        iterator's staging thread so BOTH the host read and the H2D are
-        off the training loop's critical path."""
+        them on device (single-process: chunked, in-place-assembled —
+        transport-hang guard + HBM high-water discipline; runs on the
+        dedicated staging thread, both the host read and the H2D off the
+        critical path).
+
+        Multi-process: LOCAL-ONLY construction via ``put_sharded`` →
+        ``make_array_from_process_local_data`` — every process holds the
+        identical full value, so assembly is per-device local puts with
+        no cross-process transfer. A raw cross-process ``device_put`` of
+        the replicated shard is a lockstep operation, and one issued off
+        the main thread raced the step loop's collectives into a
+        reproducible 2-process deadlock (both ranks asleep; the host
+        loaders never deadlock precisely because their staging is this
+        same local-only constructor)."""
         pixels = np.ascontiguousarray(self._images[shard_global_rows])
-        return (
-            _chunked_device_put(pixels, self._sharding, in_place=True),
-            self._labels[shard_global_rows],
-        )
+        if jax.process_count() > 1:
+            cache = mesh_lib.put_sharded(pixels, self._sharding)
+        else:
+            cache = _chunked_device_put(pixels, self._sharding, in_place=True)
+        return cache, self._labels[shard_global_rows]
 
     def iter_from(self, start_batch: int):
         """Mid-epoch resume at the batch level (shards before the target
@@ -425,13 +440,32 @@ class RotatingDeviceCache:
         shards, orders = shards[start_shard:], orders[start_shard:]
         if not shards:
             return
-        # staging thread: the next shard's memmap gather AND its H2D both
-        # run there, overlapping the whole current shard's stepping
-        pending = self._stage_async(shards[0])
+        # Single-process: dedicated staging thread — the next shard's
+        # memmap gather AND its H2D both run there, overlapping the whole
+        # current shard's stepping. Multi-process: stage INLINE in this
+        # iterator (no extra thread). Measured hazard, not theory: with
+        # the staging thread, a 2-process XLA:CPU world deadlocked
+        # reproducibly (both ranks asleep after compile) — three
+        # concurrent device-work issuers per process (staging thread's
+        # puts, the prefetch producer thread that drives this iterator
+        # under fit(), and the main thread's compiled steps whose
+        # collectives run in lockstep) let per-process orders diverge.
+        # Staging inline collapses rotation to the exact threading shape
+        # of the host-loader path — ONE producer thread issuing transfers
+        # plus the main thread issuing programs — which multi-process
+        # worlds demonstrably sustain (tests/test_multiproc_fit.py, and
+        # tests/test_multiproc_rotation.py drives THIS path through
+        # fit()+prefetch end-to-end). The cost is a staging stall per
+        # shard boundary; the per-step path stays index-only either way.
+        overlap = jax.process_count() == 1
+        pending = self._stage_async(shards[0]) if overlap else None
         for s in range(len(shards)):
-            cache, labels = self._resolve(pending)
-            if s + 1 < len(shards):
-                pending = self._stage_async(shards[s + 1])
+            if overlap:
+                cache, labels = self._resolve(pending)
+                if s + 1 < len(shards):
+                    pending = self._stage_async(shards[s + 1])
+            else:
+                cache, labels = self._stage(shards[s])
             order = orders[s]
             for lo in range(0, self.shard_rows, self._global_batch):
                 window = order[lo:lo + self._global_batch]
