@@ -4,9 +4,12 @@ tokens/sec.
 
 Emits one JSON line per metric: {"metric", "value", "unit", "vs_baseline"}.
 The first line is the BASELINE.json headline ("images/sec/chip, ResNet-50
-ImageNet"). The LAST line is a ``bench_summary`` carrying every leg's value
-in its ``legs`` field (also written to ``BENCH_SUMMARY.json``), so a
-tail-truncated stdout capture still records the whole round.
+ImageNet"). The last TWO lines are the summary pair: a full
+``bench_summary`` carrying every leg's value+unit (also written to
+``BENCH_SUMMARY.json``), then — the very last line — a compact
+``bench_summary_compact`` with values/ratios only, sized to fit the round
+driver's bounded tail window whole (the full summary's several-KB unit
+strings defeated the driver's tail parser for three rounds running).
 
 Legs
 ----
@@ -60,6 +63,12 @@ Legs
    vs_baseline = MFU vs the hand FLOP roofline.
 11. ``llama_125m_tokens_per_sec_per_chip`` / ``bert_base_mlm_tokens_per_
    sec_per_chip`` — the remaining family contracts, same MFU convention.
+12. ``gpt2_1b_shard_state_hbm_budget`` — the memory-discipline leg: a
+   ~1.1B-param GPT-2 geometry budgeted against 16 GB HBM, replicated Adam
+   (provably does not fit) vs ZeRO-1 ``optim.shard_state`` + per-block
+   remat (fits); exact pre-compile state accounting via tpudist.memory,
+   plus a live sharded-step dryrun on multi-chip attaches
+   (docs/PERF.md §10).
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -944,6 +953,163 @@ def bench_decode() -> None:
     )
 
 
+def bench_memory_discipline() -> None:
+    """The memory-discipline leg (docs/PERF.md §10): a ~1.1B-param GPT-2
+    geometry (1536 wide × 36 layers, seq 1024, vocab 50257) budgeted
+    against 16 GB HBM, replicated Adam vs ZeRO-1 ``shard_state`` +
+    per-block ``save_nothing`` remat (block boundaries only — the standard
+    recipe at this scale; ``dots_saveable`` needs micro-batch 2 at this
+    width to fit, the budget table in PERF §10 shows both).
+
+    The budget is tpudist.memory's PRE-COMPILE accounting: one eval_shape
+    trace gives exact params/opt-state bytes (the sharded side consults
+    ``optim.shard_state``'s own leaf-for-leaf sharding rule, so "per-chip
+    moments" is measured against the real layout, not world_size-rounded
+    arithmetic); activations use the documented per-policy estimate. Value
+    = the sharded configuration's per-chip GB; vs_baseline = budget /
+    value (≥ 1 means it fits). The unit string carries the replicated
+    per-chip GB — which must NOT fit — so the record holds both budgets,
+    and a dryrun train step at the same geometry scaled down 6× in depth
+    proves the shard_state+remat step actually compiles and runs when
+    devices are present."""
+    from tpudist import mesh as mesh_lib
+    from tpudist import memory, optim
+    from tpudist.models.gpt2 import GPT2
+
+    n_chips = jax.device_count()
+    # budget geometry PINNED to a v5e-8 slice so the fixed-name metric is
+    # comparable across rounds regardless of the attach's chip count; the
+    # real leaf-rule mesh is used when 8 chips exist, the arithmetic
+    # fallback (proven equal on this geometry by the emulated-mesh test)
+    # otherwise
+    world = 8
+    mesh = (
+        mesh_lib.create_mesh(
+            mesh_lib.MeshConfig(data=world), devices=jax.devices()[:world]
+        )
+        if n_chips >= world
+        else None
+    )
+
+    model = GPT2(
+        hidden_dim=1536, depth=36, num_heads=16, dtype=jnp.bfloat16,
+        attn_impl="vmem", remat_policy="save_nothing",
+    )
+    tokens = np.zeros((1, 16), np.int32)
+    micro_per_chip, seq = 4, 1024
+    tx = optax.adam(1e-3)
+    replicated = memory.train_state_budget(
+        model, tx, tokens, batch=micro_per_chip, seq=seq, world_size=1,
+        remat_policy="none",
+    )
+    if mesh is not None:
+        sharded = memory.train_state_budget(
+            model, optim.shard_state(tx, mesh), tokens,
+            batch=micro_per_chip, seq=seq,
+            world_size=world, remat_policy="save_nothing",
+        )
+    else:
+        # single-chip attach: an 8-way mesh isn't constructible, so the
+        # 8-way budget divides the moments arithmetically instead of
+        # consulting shard_state's leaf rule — same number: every big
+        # GPT-2 leaf is 8-divisible (the emulated-mesh test pins the
+        # leaf rule to exactly 1/world on this geometry)
+        sharded = memory.train_state_budget(
+            model, tx, tokens, batch=micro_per_chip, seq=seq,
+            world_size=world, remat_policy="save_nothing",
+        )
+        opt_pc = sharded["opt_state_bytes_global"] // world
+        subtotal = (
+            sharded["params_bytes"] + opt_pc + sharded["grad_bytes"]
+            + sharded["activation_bytes_est"]
+        )
+        # recover the report's own workspace fraction from its fields so
+        # the rebuilt components sum exactly to the rebuilt total (no
+        # second copy of the constant to drift)
+        ws_base = sharded["per_chip_total_bytes"] - sharded["workspace_bytes_est"]
+        frac = sharded["workspace_bytes_est"] / ws_base
+        total = int(subtotal * (1.0 + frac))
+        sharded.update(
+            opt_state_bytes_per_chip=int(opt_pc),
+            per_chip_total_bytes=total,
+            workspace_bytes_est=total - subtotal,
+            fits=bool(total <= sharded["hbm_budget_bytes"]),
+            bytes_per_param=round(total / sharded["n_params"], 2),
+        )
+    gb = 1024 ** 3
+    _record_line(
+        {
+            "metric": "gpt2_1b_shard_state_hbm_budget",
+            "value": round(sharded["per_chip_total_bytes"] / gb, 2),
+            "unit": "GB/chip, GPT-2 1536x36 (~%.2fB params), seq 1024, "
+            "micro-batch 4/chip, ZeRO-1 shard_state over %d replicas + "
+            "per-block save_nothing remat (%.1f B/param) — vs the same "
+            "geometry REPLICATED + no remat: %.2f GB/chip (%s 16 GB; "
+            "%.1f B/param); pre-compile budget, tpudist.memory "
+            "accounting, docs/PERF.md §10" % (
+                sharded["n_params"] / 1e9, world,
+                sharded["bytes_per_param"],
+                replicated["per_chip_total_bytes"] / gb,
+                "also under" if replicated["fits"] else "provably over",
+                replicated["bytes_per_param"],
+            ),
+            "vs_baseline": round(
+                sharded["hbm_budget_bytes"] / sharded["per_chip_total_bytes"],
+                4,
+            ),
+        }
+    )
+    print("bench: memory budget replicated: "
+          + memory.format_budget(replicated), flush=True)
+    print("bench: memory budget shard_state: "
+          + memory.format_budget(sharded), flush=True)
+
+    # dryrun (best-effort, budgets above are already recorded): the
+    # shard_state + remat step, live, at the same width but depth/6 (the
+    # per-chip HBM of THIS attach bounds what a bench can instantiate;
+    # depth scales state linearly, so the layout/collective path is
+    # identical) — proves the composed step compiles and trains
+    if n_chips > 1:
+        import sys
+        import traceback
+
+        try:
+            from tpudist.train import (
+                create_train_state, lm_loss, make_train_step,
+                state_shardings_of,
+            )
+
+            dmesh = mesh_lib.create_mesh()
+            small = GPT2(
+                hidden_dim=1536, depth=6, num_heads=16, dtype=jnp.bfloat16,
+                attn_impl="vmem", mesh=dmesh, remat_policy="save_nothing",
+            )
+            stx = optim.shard_state(optax.adam(1e-3), dmesh)
+            state = create_train_state(
+                small, 0, jnp.zeros((n_chips, 16), jnp.int32), stx, dmesh
+            )
+            step = make_train_step(
+                small, stx, dmesh, loss_fn=lm_loss, input_key="tokens",
+                label_key="tokens", state_sharding=state_shardings_of(state),
+            )
+            rng = np.random.Generator(np.random.PCG64(0))
+            batch = {"tokens": rng.integers(
+                0, 50257, (micro_per_chip * n_chips, seq)).astype(np.int32)}
+            for _ in range(3):
+                state, metrics = step(state, batch)
+            float(metrics["loss"])
+            stats = memory.device_memory_stats()
+            print("bench: shard_state dryrun step ok, loss=%.3f, hbm=%s"
+                  % (float(metrics["loss"]), stats), flush=True)
+        except Exception:
+            # budgets above are the leg's record; the live dryrun is
+            # extra evidence — report the failure loudly, don't lose the
+            # recorded metric to it
+            traceback.print_exc()
+            print("bench: shard_state dryrun step FAILED (budgets above "
+                  "still recorded)", file=sys.stderr, flush=True)
+
+
 def _run_with_retry(fn) -> None:
     """The remote-compile tunnel occasionally 500s transiently; one retry
     keeps a flake from recording a failed benchmark for the whole round.
@@ -997,6 +1163,9 @@ _LEG_GROUPS = {
     "t5": (bench_t5, 1800),
     "families": (bench_families, 1800),
     "decode": (bench_decode, 1800),  # +300s: the batch-128 serving leg
+    # budgets are eval_shape-only (seconds); the generous cap covers the
+    # optional multi-chip dryrun step's compile
+    "memory": (bench_memory_discipline, 1500),
 }
 
 
@@ -1101,6 +1270,25 @@ def _emit_summary(record_path: str, ok: dict[str, bool],
         json.dump(summary, f, indent=2)
         f.write("\n")
     print(json.dumps(summary), flush=True)
+    # THE VERY LAST LINE is a COMPACT summary: values and ratios only, no
+    # unit prose. The round driver keeps a bounded tail window of stdout
+    # and parses its last JSON line; the full bench_summary above carries
+    # every leg's multi-sentence unit string and has measured several KB —
+    # the driver's window started MID-LINE and parsed nothing for three
+    # rounds running (VERDICT r5 "parsed: null"). This line is sized to
+    # survive any sane tail window (tests/test_bench_record.py bounds it).
+    compact = {
+        "metric": "bench_summary_compact",
+        "value": float(len(legs)),
+        "unit": "legs",
+        "vs_baseline": summary["vs_baseline"],
+        "legs": {
+            m: {"value": o["value"], "vs_baseline": o["vs_baseline"]}
+            for m, o in legs.items()
+        },
+        "failed_leg_groups": summary["failed_leg_groups"],
+    }
+    print(json.dumps(compact, separators=(",", ":")), flush=True)
 
 
 def main() -> None:

@@ -56,8 +56,21 @@ def parse_args(argv=None):
                    "guard on the optimizer")
     p.add_argument("--dropout", default=0.0, type=float,
                    help="embedding+residual dropout rate (GPT-2 paper: 0.1)")
-    p.add_argument("--remat", action="store_true",
-                   help="jax.checkpoint the forward (HBM for FLOPs)")
+    p.add_argument("--remat", default=None, nargs="?", const="full",
+                   choices=["none", "full", "dots_saveable", "save_nothing"],
+                   help="whole-forward jax.checkpoint under a named policy "
+                   "(tpudist.remat; bare --remat = full, the legacy "
+                   "behavior)")
+    p.add_argument("--remat_policy", default=None,
+                   choices=["none", "full", "dots_saveable", "save_nothing"],
+                   help="per-BLOCK checkpoint policy on the transformer "
+                   "blocks (the deep-model memory lever; works unrolled "
+                   "and with --scan_layers)")
+    p.add_argument("--shard_opt_state", action="store_true",
+                   help="ZeRO-1 cross-replica optimizer-state sharding "
+                   "(tpudist.optim.shard_state): Adam mirrors live "
+                   "~1/world_size per chip; with --remat_policy this is "
+                   "the ~1B-on-16GB recipe (docs/PERF.md §10)")
     p.add_argument("--chunked_ce", default=0, type=int,
                    help="sequence-chunked weight-tied CE (chunk size); the "
                    "[B,S,V] logits never materialize — raises the max batch/"
@@ -255,6 +268,11 @@ def main(argv=None):
                     "--scan_layers/--remat_layers are not supported with --pipe "
                     "(the pipeline already stacks blocks over the 'pipe' axis)"
                 )
+            if args.remat_policy:
+                raise SystemExit(
+                    "--remat_policy is not supported with --pipe (checkpoint "
+                    "the whole forward with --remat instead)"
+                )
             return PipelinedGPT2(
                 mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
                 max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
@@ -278,7 +296,7 @@ def main(argv=None):
                 num_kv_heads=args.num_kv_heads or None,
                 ffn_dim=args.ffn_dim or None, rope_theta=args.rope_theta,
                 tie_embeddings=args.tie_embeddings, scan_layers=scan_layers,
-                remat_layers=remat_layers,
+                remat_layers=remat_layers, remat_policy=args.remat_policy,
                 num_experts=args.experts,  # Mixtral-style SwiGLU experts
                 dtype=dtype, attn_impl=args.attn, mesh=mesh,
             )
@@ -293,6 +311,7 @@ def main(argv=None):
             num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
             num_experts=args.experts, mesh=mesh, dropout=args.dropout,
             scan_layers=scan_layers, remat_layers=remat_layers,
+            remat_policy=args.remat_policy,
         )
 
     model = build_model(args.scan_layers, args.remat_layers)
@@ -386,6 +405,7 @@ def main(argv=None):
             world_size=dp_size, global_rank=ctx.process_index,
             loss_fn=lm_loss, input_key="tokens", label_key="tokens",
             grad_accum=args.grad_accum, remat=remat,
+            shard_opt_state=args.shard_opt_state,
             batch_spec=batch_spec, forward_loss=fwd_loss,
             profile=not args.no_profiler, log_dir=args.log_dir,
             checkpoint_dir=args.checkpoint_dir,

@@ -55,7 +55,10 @@ def test_summary_carries_every_leg(bench, tmp_path, capsys):
         out_path=str(tmp_path / "BENCH_SUMMARY.json"),
     )
     out = capsys.readouterr().out.strip().splitlines()
-    summary = json.loads(out[-1])
+    # the LAST line is the compact tail-parser line; the full summary
+    # with unit strings is the line before it
+    assert json.loads(out[-1])["metric"] == "bench_summary_compact"
+    summary = json.loads(out[-2])
     assert summary["metric"] == "bench_summary"
     assert set(summary["legs"]) == {
         "resnet50_train_images_per_sec_per_chip",
@@ -66,6 +69,41 @@ def test_summary_carries_every_leg(bench, tmp_path, capsys):
     assert summary["failed_leg_groups"] == ["gpt2"]
     on_disk = json.loads((tmp_path / "BENCH_SUMMARY.json").read_text())
     assert on_disk["legs"] == summary["legs"]
+
+
+def test_final_line_is_compact_and_parses(bench, tmp_path, capsys):
+    """The driver keeps only a bounded TAIL of stdout and parses its last
+    JSON line. The full bench_summary carries every leg's multi-sentence
+    unit string and measured several KB — three rounds of
+    ``parsed: null`` (VERDICT r5). The LAST line must therefore be the
+    COMPACT summary: every leg's value/vs_baseline, no unit prose, small
+    enough that any sane tail window contains it whole."""
+    for i in range(14):  # a full round's leg count
+        bench._emit(
+            f"some_leg_with_a_realistically_long_metric_name_{i:02d}",
+            123456.78, "tokens/sec/chip with a long explanatory unit " * 4,
+            100000.0,
+        )
+    capsys.readouterr()
+    bench._emit_summary(
+        bench._test_record_path, {"a": True},
+        out_path=str(tmp_path / "BENCH_SUMMARY.json"),
+    )
+    lines = capsys.readouterr().out.strip().splitlines()
+    last = lines[-1]
+    compact = json.loads(last)  # the driver's exact operation
+    assert compact["metric"] == "bench_summary_compact"
+    assert len(compact["legs"]) == 14
+    for leg in compact["legs"].values():
+        assert set(leg) == {"value", "vs_baseline"}  # no unit prose
+    # sized for the tail window: every leg name + 2 floats, nothing else.
+    # 14 legs of this record's real name lengths fit in well under 2 KB;
+    # the full summary above it measured >5 KB.
+    assert len(last) < 2048, len(last)
+    # and the big summary (second-to-last) still carries the units
+    full = json.loads(lines[-2])
+    assert full["metric"] == "bench_summary"
+    assert "unit" in next(iter(full["legs"].values()))
 
 
 def test_summary_survives_corrupt_lines(bench, capsys, tmp_path):

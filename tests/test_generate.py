@@ -89,6 +89,45 @@ def test_generate_llama_runs_and_respects_cache_bound():
         generate(model, params, prompt, 13)
 
 
+def test_gpt2_direct_decode_overrun_fails_loudly():
+    """Direct incremental decode past max_seq_len (generate() guards its
+    own entry; a direct model.apply caller used to get a silently-clamped
+    wpe slice and a clobbered cache slot): eager callers get a
+    ValueError, jitted loops get NaN logits for the overrunning step."""
+    model = GPT2(vocab_size=64, max_seq_len=4, hidden_dim=32, depth=1,
+                 num_heads=4)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 1), jnp.int32), train=False
+    )["params"]
+    cache = model.init(
+        jax.random.key(0), jnp.zeros((1, 1), jnp.int32), train=False,
+        decode=True,
+    )["cache"]
+    tok = jnp.ones((1, 1), jnp.int32)
+
+    def step(cache):
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tok,
+            train=False, decode=True, mutable=["cache"],
+        )
+        return logits, upd["cache"]
+
+    for _ in range(4):
+        logits, cache = step(cache)
+        assert np.isfinite(np.asarray(logits)).all()
+    with pytest.raises(ValueError, match="max_seq_len"):
+        step(cache)
+
+    jit_step = jax.jit(step)
+    cache = model.init(
+        jax.random.key(0), jnp.zeros((1, 1), jnp.int32), train=False,
+        decode=True,
+    )["cache"]
+    for i in range(5):
+        logits, cache = jit_step(cache)
+        assert np.isfinite(np.asarray(logits)).all() == (i < 4), i
+
+
 def test_sample_logits_modes():
     logits = jnp.asarray([[0.0, 5.0, 1.0, -2.0]] * 3)
     greedy = sample_logits(logits, jax.random.key(0), temperature=0.0)
@@ -126,6 +165,21 @@ def test_sample_logits_top_p_nucleus():
         for i in range(10)
     }
     assert only_top == {0}
+    # top_p=0.0 (the degenerate edge: exclusive-cum < 0 keeps NOTHING
+    # without the guard — threshold +inf, categorical over all -inf) must
+    # still return the most likely token, per the docstring's guarantee
+    # (HF's min_tokens_to_keep=1); and identically through the top_k
+    # composition, whose nucleus runs over the top-k subset
+    zero_p = {
+        int(sample_logits(logits, jax.random.key(i), top_p=0.0)[0])
+        for i in range(10)
+    }
+    assert zero_p == {0}
+    zero_p_k = {
+        int(sample_logits(logits, jax.random.key(i), top_k=3, top_p=0.0)[0])
+        for i in range(10)
+    }
+    assert zero_p_k == {0}
     # p=1.0 is a no-op: every token reachable at high temperature
     all_tok = {
         int(sample_logits(logits, jax.random.key(i), temperature=5.0,

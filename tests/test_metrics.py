@@ -39,6 +39,35 @@ def test_nonzero_rank_writes_header_only(tmp_path):
     assert len(lines) == 2 and lines[1].startswith("TrainTime\t")
 
 
+def test_hbm_row_rank0_only_and_noop_without_stats(tmp_path):
+    """log_memory writes one tagged HBM row (rank 0, stats present), like
+    the TrainTime footer — and never touches the reference's data-row
+    contract. None/{} (CPU backends report nothing) is a silent no-op."""
+    import json
+
+    logger = MetricsLogger("J", 8, 0, 1, log_dir=tmp_path)
+    logger.log_memory(None)
+    logger.log_memory({})
+    stats = {"bytes_in_use": 123, "bytes_limit": 456}
+    logger.log_memory(stats)
+    logger.finish()
+    lines = logger.file_name.read_text().splitlines()
+    hbm = [l for l in lines if l.startswith("HBM\t")]
+    assert len(hbm) == 1
+    assert json.loads(hbm[0].split("\t", 1)[1]) == stats
+    # rank != 0 writes nothing
+    other = MetricsLogger("J", 8, 2, 4, log_dir=tmp_path)
+    other.log_memory(stats)
+    other.finish()
+    assert "HBM" not in other.file_name.read_text()
+    # the live-stats provider contract: dict or None, never raises on CPU
+    from tpudist.memory import device_memory_stats
+
+    assert device_memory_stats() is None or isinstance(
+        device_memory_stats(), dict
+    )
+
+
 def test_traintime_footer_format(tmp_path):
     logger = MetricsLogger("J", 1, 0, 1, log_dir=tmp_path)
     t = logger.finish()

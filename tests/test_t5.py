@@ -185,6 +185,62 @@ def test_t5_incremental_decode_matches_full_forward():
     )
 
 
+def test_t5_decode_overrun_fails_loudly():
+    """Past max_decode_len the bias dynamic_slice and the cache update
+    would silently CLAMP (wrong biases, clobbered last slot — ADVICE r5):
+    the decode path must fail loudly instead. Eager direct callers get a
+    ValueError; a jitted decode loop gets NaN logits for the overrunning
+    step (deterministic poison, not plausible-looking garbage)."""
+    model = T5(**_CFG, max_decode_len=4)
+    rng = np.random.Generator(np.random.PCG64(1))
+    enc = jnp.asarray(rng.integers(1, 40, (2, 6)), jnp.int32)
+    params = model.init(jax.random.key(0), (enc, enc), train=False)["params"]
+    enc_out = model.apply(
+        {"params": params}, enc, train=False, encode_only=True
+    )
+
+    def fresh_cache():
+        return model.init(
+            jax.random.key(0), jnp.zeros((2, 1), jnp.int32), train=False,
+            decode=True, enc=jnp.zeros((2, 1, model.hidden_dim),
+                                       enc_out.dtype),
+        )["cache"]
+
+    tok = jnp.ones((2, 1), jnp.int32)
+
+    def step(cache):
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tok,
+            train=False, decode=True, enc=enc_out, mutable=["cache"],
+        )
+        return logits, upd["cache"]
+
+    # a chunk longer than the buffer is a static, immediate refusal
+    with pytest.raises(ValueError, match="max_decode_len"):
+        model.apply(
+            {"params": params, "cache": fresh_cache()},
+            jnp.ones((2, 5), jnp.int32),
+            train=False, decode=True, enc=enc_out, mutable=["cache"],
+        )
+
+    # eager incremental decode: 4 steps fill the buffer, the 5th raises
+    cache = fresh_cache()
+    for _ in range(4):
+        logits, cache = step(cache)
+        assert np.isfinite(np.asarray(logits)).all()
+    with pytest.raises(ValueError, match="max_decode_len"):
+        step(cache)
+
+    # jitted loop (cursor is a tracer): the overrunning step's logits are
+    # NaN — loud in any downstream use — while in-bounds steps stay finite
+    jit_step = jax.jit(step)
+    cache = fresh_cache()
+    for i in range(5):
+        logits, cache = jit_step(cache)
+        finite = np.isfinite(np.asarray(logits)).all()
+        assert finite == (i < 4), (i, finite)
+
+
 def test_generate_seq2seq_greedy_matches_full_forward_rollout():
     """Greedy generate_seq2seq equals repeatedly argmaxing the joint
     teacher-forced forward — generation and training-path numerics agree

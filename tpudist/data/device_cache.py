@@ -89,7 +89,38 @@ def _chunked_device_put(
     return buf
 
 
-@functools.lru_cache(maxsize=16)
+def _chunked_replicated_put(x: np.ndarray, sharding) -> jax.Array:
+    """Multi-process-safe chunked staging of a REPLICATED value.
+
+    ``put_sharded``'s multi-process path
+    (``make_array_from_process_local_data``) issues ONE full-shard
+    ``device_put`` per device — for a GB-scale rotation shard that is
+    exactly the single hundreds-of-MB transfer the ~64 MB
+    ``_chunked_device_put`` guard exists to prevent (observed to hang a
+    remote-attach transport outright). This constructor keeps BOTH
+    disciplines at once:
+
+    - **chunked**: per addressable device, the full value is assembled in
+      ~64 MB slices into a donated single-device buffer
+      (``_chunked_device_put(..., in_place=True)`` under a
+      ``SingleDeviceSharding``);
+    - **local-only** (the 2-process-deadlock fix, see ``_stage``): every
+      operation here is either a transfer or a single-device,
+      collective-free compiled program — nothing lockstep, so per-process
+      issue orders may diverge freely while the main thread runs
+      collective train steps. The final
+      ``make_array_from_single_device_arrays`` is metadata-only.
+    """
+    from jax.sharding import SingleDeviceSharding
+
+    bufs = [
+        _chunked_device_put(x, SingleDeviceSharding(d), in_place=True)
+        for d in sorted(sharding.addressable_devices, key=lambda d: d.id)
+    ]
+    return jax.make_array_from_single_device_arrays(x.shape, sharding, bufs)
+
+
+@functools.lru_cache(maxsize=64)  # 8 local devices x a few shard shapes
 def _assembly_fns(shape: tuple, dtype_str: str, sharding):
     """Jitted (zeros-init, donated-write) pair for in-place assembly,
     cached per (shape, dtype, sharding): jit's executable cache keys on
@@ -379,18 +410,21 @@ class RotatingDeviceCache:
         dedicated staging thread, both the host read and the H2D off the
         critical path).
 
-        Multi-process: LOCAL-ONLY construction via ``put_sharded`` →
-        ``make_array_from_process_local_data`` — every process holds the
-        identical full value, so assembly is per-device local puts with
-        no cross-process transfer. A raw cross-process ``device_put`` of
-        the replicated shard is a lockstep operation, and one issued off
-        the main thread raced the step loop's collectives into a
-        reproducible 2-process deadlock (both ranks asleep; the host
-        loaders never deadlock precisely because their staging is this
-        same local-only constructor)."""
+        Multi-process: LOCAL-ONLY construction, now ALSO chunked —
+        ``_chunked_replicated_put`` assembles the replicated shard
+        per-device in ~64 MB slices (every process holds the identical
+        full value, so assembly needs no cross-process transfer, and the
+        slicing keeps the documented transport-hang guard that a single
+        full-shard ``device_put`` per device — the old ``put_sharded``
+        route — bypassed). A raw cross-process ``device_put`` of the
+        replicated shard is a lockstep operation, and one issued off the
+        main thread raced the step loop's collectives into a reproducible
+        2-process deadlock (both ranks asleep; the host loaders never
+        deadlock precisely because their staging is this same local-only
+        constructor)."""
         pixels = np.ascontiguousarray(self._images[shard_global_rows])
         if jax.process_count() > 1:
-            cache = mesh_lib.put_sharded(pixels, self._sharding)
+            cache = _chunked_replicated_put(pixels, self._sharding)
         else:
             cache = _chunked_device_put(pixels, self._sharding, in_place=True)
         return cache, self._labels[shard_global_rows]

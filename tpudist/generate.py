@@ -44,6 +44,13 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
         probs = jax.nn.softmax(sorted_desc, axis=-1)
         exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
         keep = exclusive_cum < top_p
+        # the docstring's guarantee, unconditionally: at top_p <= 0.0 (or
+        # denormal-tiny p) the exclusive-cum test keeps NOTHING, the
+        # threshold becomes +inf and categorical samples over all -inf
+        # logits — undefined output. HF guards the same edge with
+        # min_tokens_to_keep=1; position 0 of the descending sort IS the
+        # most likely token, so force-keep it.
+        keep = keep.at[..., 0].set(True)
         return jnp.min(
             jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
         )

@@ -1,0 +1,262 @@
+"""HBM accounting: byte budgets BEFORE compile, live device stats after.
+
+The memory-discipline half of the perf story (docs/PERF.md §10): at ~1B
+params on a 16 GB chip the question "will it fit?" must be answerable
+before the first (minutes-long) compile, and the answer must be checkable
+against what the device actually allocated. Three layers:
+
+1. **per-tree bytes, exact** — :func:`tree_bytes` / :func:`per_device_bytes`
+   work on concrete arrays, ``jax.eval_shape`` results, or (shape-tree,
+   sharding-tree) pairs, so the params/master/moments budget costs one
+   trace, no device.
+2. **activation estimate, analytic** — :func:`transformer_activation_bytes`
+   models the saved-residual footprint per remat policy (documented coarse
+   coefficients; an estimate, clearly labeled as one).
+3. **live stats** — :func:`device_memory_stats` surfaces the runtime
+   allocator's view (``bytes_in_use``/``peak_bytes_in_use``/``bytes_limit``
+   on TPU; ``None`` on backends that don't report, e.g. CPU), logged by
+   ``fit()`` through ``MetricsLogger.log_memory``.
+
+:func:`train_state_budget` assembles 1+2 into the report the bench's ~1B
+leg prints: bytes-per-param for params / moments / activations, replicated
+vs ``shard_state``, against a stated HBM budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from tpudist.utils.tree import tree_bytes, tree_size
+
+__all__ = [
+    "tree_bytes",
+    "tree_size",
+    "per_device_bytes",
+    "state_bytes",
+    "transformer_activation_bytes",
+    "train_state_budget",
+    "device_memory_stats",
+    "format_budget",
+]
+
+
+def per_device_bytes(tree, shardings=None) -> int:
+    """Bytes ONE device holds for ``tree``.
+
+    ``tree`` may be concrete placed arrays (their own ``.sharding`` is
+    used) or a shape tree (``jax.eval_shape`` output) paired with a
+    matching ``shardings`` tree. Replicated leaves count in full; sharded
+    leaves count their largest single-device shard (ceil division — the
+    padded shard is what the allocator actually reserves).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if shardings is not None:
+        # flatten the shardings UP TO the value tree's structure: a
+        # structural mismatch raises (never a silent zip truncation), and
+        # a None left at a leaf position survives as "replicated" instead
+        # of being dropped by tree_leaves and misaligning every later pair
+        shard_leaves = treedef.flatten_up_to(shardings)
+    else:
+        shard_leaves = [getattr(x, "sharding", None) for x in leaves]
+    total = 0
+    for x, s in zip(leaves, shard_leaves):
+        shape = tuple(np.shape(x)) if not hasattr(x, "shape") else tuple(x.shape)
+        if s is not None and hasattr(s, "shard_shape"):
+            shape = s.shard_shape(shape)
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(x.dtype).itemsize
+    return total
+
+
+def state_bytes(state, shardings=None) -> dict[str, dict[str, int]]:
+    """Per-component byte table for a TrainState(-shaped) tree.
+
+    Returns ``{component: {"global": bytes, "per_device": bytes}}`` for
+    ``params`` / ``opt_state`` / ``batch_stats`` plus a ``total`` row.
+    ``state`` may be concrete or an ``eval_shape`` result (then pass the
+    matching ``shardings`` tree, e.g. from ``optim.shard_state``'s
+    ``state_shardings`` — that pairing is how the pre-compile budget knows
+    the moments will live at ~1/world_size per chip).
+    """
+    out: dict[str, dict[str, int]] = {}
+    total_g = total_d = 0
+    for name in ("params", "opt_state", "batch_stats"):
+        sub = getattr(state, name, None)
+        if sub is None:
+            continue
+        sh = getattr(shardings, name, None) if shardings is not None else None
+        g = tree_bytes(sub)
+        d = per_device_bytes(sub, sh)
+        out[name] = {"global": g, "per_device": d}
+        total_g += g
+        total_d += d
+    out["total"] = {"global": total_g, "per_device": total_d}
+    return out
+
+
+def transformer_activation_bytes(
+    batch: int,
+    seq: int,
+    hidden: int,
+    depth: int,
+    *,
+    num_heads: int | None = None,
+    remat_policy: str | bool | None = "none",
+    dtype_bytes: int = 2,
+    ffn_mult: int = 4,
+    attention_scores: bool = False,
+) -> int:
+    """ESTIMATED live activation bytes of one transformer microbatch's
+    forward, as held for backward under ``remat_policy``.
+
+    Coarse per-token-per-layer accounting (bf16 default), stated so the
+    numbers are auditable rather than mysterious:
+
+    - ``none``: every block internal is saved — residual in + 2 norms +
+      qkv (3H) + attn out + proj in + mlp up (ffn_mult·H) + gelu
+      (ffn_mult·H) + proj ≈ ``(8 + 2·ffn_mult)·H`` per layer;
+    - ``dots_saveable``: dot/MXU outputs only — qkv (3H) + attn out +
+      mlp up (ffn_mult·H) + proj ≈ ``(5 + ffn_mult)·H``;
+    - ``full`` / ``save_nothing`` (per-block checkpoint): the inter-block
+      residual stream (1·H per layer) plus ONE block's internals live
+      during its recompute.
+
+    ``attention_scores=True`` adds the [B, heads, S, S] score matrix per
+    layer (the XLA-attention path; the fused kernels never materialize
+    it). Plus the embedding output once. This is an estimate for budget
+    tables — the measured check is :func:`device_memory_stats`.
+    """
+    per_tok = {
+        "none": (8 + 2 * ffn_mult) * hidden,
+        "dots_saveable": (5 + ffn_mult) * hidden,
+        "full": hidden,
+        "save_nothing": hidden,
+    }
+    key = {False: "none", None: "none", True: "full"}.get(
+        remat_policy, remat_policy
+    )
+    if key not in per_tok:
+        raise ValueError(f"unknown remat policy {remat_policy!r}")
+    tokens = batch * seq
+    per_layer = per_tok[key] * tokens
+    if attention_scores and key in ("none", "dots_saveable"):
+        per_layer += (num_heads or 1) * batch * seq * seq
+    total = depth * per_layer + tokens * hidden  # + embedding output
+    if key in ("full", "save_nothing"):
+        # one block's internals, alive during its backward recompute
+        total += (8 + 2 * ffn_mult) * hidden * tokens
+    return int(total) * dtype_bytes
+
+
+def train_state_budget(
+    model,
+    tx,
+    sample_input,
+    *,
+    batch: int,
+    seq: int,
+    world_size: int = 1,
+    remat_policy: str | bool | None = "none",
+    grad_dtype_bytes: int = 4,
+    hbm_budget_bytes: int = 16 * 1024**3,
+    workspace_fraction: float = 0.08,
+) -> dict[str, Any]:
+    """The pre-compile fits-or-not report for one LM training config.
+
+    One ``jax.eval_shape`` trace (no device, no compile — a ~1B model
+    costs seconds on a laptop) yields exact params/opt-state bytes;
+    activations come from :func:`transformer_activation_bytes` using the
+    model's ``hidden_dim``/``depth``/``num_heads`` fields; gradients count
+    one params-sized fp32 tree (the donated step's transient);
+    ``workspace_fraction`` reserves allocator/fusion scratch. Optimizer
+    state divides by ``world_size`` when ``tx`` is a
+    ``tpudist.optim.shard_state`` wrapper (its own ``state_shardings``
+    rule is consulted leaf-for-leaf — exact, not world_size-rounded).
+
+    Returns a dict with per-component bytes (global and per-chip), the
+    per-chip total, ``fits`` against ``hbm_budget_bytes``, and
+    ``bytes_per_param`` — the budget-table row docs/PERF.md §10 prints.
+    """
+    import jax.numpy as jnp
+
+    params_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.asarray(sample_input), train=False
+        )["params"]
+    )
+    n_params = tree_size(params_shapes)
+    params_bytes = tree_bytes(params_shapes)
+    opt_shapes = jax.eval_shape(tx.init, params_shapes)
+    opt_global = tree_bytes(opt_shapes)
+    if hasattr(tx, "state_shardings"):
+        opt_per_chip = per_device_bytes(
+            opt_shapes, tx.state_shardings(params_shapes)
+        )
+    else:
+        opt_per_chip = opt_global
+    acts = transformer_activation_bytes(
+        batch, seq, int(getattr(model, "hidden_dim", 0) or 0),
+        int(getattr(model, "depth", 0) or 0),
+        num_heads=getattr(model, "num_heads", None),
+        remat_policy=remat_policy,
+        # "auto" may dispatch to the XLA path (shape-dependent), so it
+        # counts the [B,H,S,S] scores too — over-budgeting is the safe
+        # direction for a fits verdict; only an explicit kernel choice
+        # (vmem/flash, which never materialize scores) drops the term
+        attention_scores=getattr(model, "attn_impl", "xla") in ("xla", "auto"),
+    )
+    grads = n_params * grad_dtype_bytes
+    subtotal = params_bytes + opt_per_chip + acts + grads
+    per_chip_total = int(subtotal * (1.0 + workspace_fraction))
+    return {
+        "n_params": int(n_params),
+        "world_size": int(world_size),
+        "remat_policy": str(remat_policy),
+        "params_bytes": int(params_bytes),
+        "opt_state_bytes_global": int(opt_global),
+        "opt_state_bytes_per_chip": int(opt_per_chip),
+        "grad_bytes": int(grads),
+        "activation_bytes_est": int(acts),
+        "workspace_bytes_est": int(per_chip_total - subtotal),
+        "per_chip_total_bytes": per_chip_total,
+        "hbm_budget_bytes": int(hbm_budget_bytes),
+        "fits": bool(per_chip_total <= hbm_budget_bytes),
+        "bytes_per_param": round(per_chip_total / max(n_params, 1), 2),
+    }
+
+
+def format_budget(report: Mapping[str, Any]) -> str:
+    """One human line per component, GB with the fits verdict — what the
+    bench leg and PERF table print."""
+    gb = 1024**3
+
+    def f(k):
+        return f"{report[k] / gb:.2f}"
+
+    return (
+        f"params {f('params_bytes')} GB + opt_state "
+        f"{f('opt_state_bytes_per_chip')} GB/chip "
+        f"(global {f('opt_state_bytes_global')}) + grads {f('grad_bytes')} "
+        f"GB + acts~{f('activation_bytes_est')} GB (remat="
+        f"{report['remat_policy']}) + ws~{f('workspace_bytes_est')} GB = "
+        f"{f('per_chip_total_bytes')} GB/chip vs {f('hbm_budget_bytes')} GB"
+        f" -> {'FITS' if report['fits'] else 'DOES NOT FIT'} "
+        f"({report['bytes_per_param']} B/param, world={report['world_size']})"
+    )
+
+
+def device_memory_stats(device=None) -> dict[str, int] | None:
+    """Live allocator stats for one device — ``bytes_in_use`` /
+    ``peak_bytes_in_use`` / ``bytes_limit`` (whatever subset the backend
+    reports), or ``None`` where unsupported (CPU). The measured
+    counterpart of :func:`train_state_budget`."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats()
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_free_block_bytes")
+    out = {k: int(v) for k, v in stats.items() if k in keep}
+    return out or {k: int(v) for k, v in stats.items()}

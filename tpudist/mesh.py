@@ -142,6 +142,31 @@ def batch_sharding(mesh: Mesh, *, extra_dims: int = 3) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def largest_divisible_spec(
+    shape, axis: str, size: int, *, min_size: int = 1024
+) -> P:
+    """PartitionSpec sharding the largest ``size``-divisible dim of
+    ``shape`` over mesh axis ``axis`` — the ONE spec rule shared by
+    ZeRO-style state sharding over ``data`` (``tpudist.optim.shard_state``)
+    and ZeRO-3 param sharding over ``fsdp``
+    (``tpudist.parallel.fsdp.fsdp_spec``).
+
+    Leaves smaller than ``min_size`` elements (biases, norm scales,
+    scalars) stay replicated — sharding them buys no memory and costs a
+    collective. Returns ``P()`` when nothing qualifies (the caller decides
+    whether to fall back to replication or to pad-and-reshape).
+    """
+    if size <= 1 or math.prod(shape) < min_size:
+        return P()
+    candidates = [(d, i) for i, d in enumerate(shape) if d % size == 0]
+    if not candidates:
+        return P()
+    _, dim = max(candidates)
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    return P(*spec)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding — used for model parameters in plain DP,
     mirroring DDP's replicate-everywhere model (/root/reference/main.py:83).

@@ -72,6 +72,20 @@ class MetricsLogger:
         if self.global_rank == 0 and idx % self.print_every == 0:
             print("Epoch: {} step: {} loss: {}".format(epoch, idx, loss_value))
 
+    def log_memory(self, stats: dict | None) -> None:
+        """One ``HBM\\t{json}`` row (rank 0) with live device memory stats
+        (``tpudist.memory.device_memory_stats``) — the measured side of the
+        pre-compile HBM budget, written next to the throughput rows it
+        explains. Footer-style like ``TrainTime`` (a tagged row, not a data
+        row), so the reference's field-exact TSV contract is untouched.
+        No-op when the backend reports nothing (CPU) or off rank 0."""
+        if not stats or self.global_rank != 0:
+            return
+        import json
+
+        self._file.write("HBM\t%s\n" % json.dumps(stats, sort_keys=True))
+        self._file.flush()
+
     def finish(self) -> float:
         train_time = time.time() - self._train_begin
         self._file.write("TrainTime\t%f\n" % train_time)

@@ -188,8 +188,16 @@ class GPT2(nn.Module):
     # the same name). Dense blocks only; decode/MoE use the unrolled layout.
     scan_layers: bool = False
     # remat_layers=True checkpoints each scanned layer (store layer
-    # boundaries, recompute inside) — requires scan_layers
+    # boundaries, recompute inside) — requires scan_layers; legacy sugar
+    # for remat_policy="full"
     remat_layers: bool = False
+    # per-BLOCK rematerialization policy (tpudist.remat names: "full",
+    # "dots_saveable", "save_nothing"; None/"none" off). Works in BOTH
+    # layouts — scanned (policy on the scanned body) and unrolled (each
+    # h_{i} checkpointed, param names unchanged) — so deep models trade
+    # recompute for activation HBM without switching layouts. Ignored on
+    # the decode path (the KV-cache step has no backward).
+    remat_policy: str | None = None
 
     @property
     def has_aux_loss(self) -> bool:
@@ -214,15 +222,34 @@ class GPT2(nn.Module):
             pos_var = self.variable(
                 "cache", "position", lambda: jnp.zeros((), jnp.int32)
             )
-            pos = jax.lax.dynamic_slice(wpe, (pos_var.value, 0),
+            # overrun guard, same contract as T5's decode path: past
+            # max_seq_len the wpe dynamic_slice (and the KV caches'
+            # update) would clamp silently; fail loudly eagerly, NaN-
+            # poison the step under jit (generate() bounds-checks at
+            # entry, so the guarded path never pays it)
+            cursor = pos_var.value
+            overrun = cursor + s > self.max_seq_len
+            if not isinstance(cursor, jax.core.Tracer) and bool(overrun):
+                raise ValueError(
+                    f"incremental decode past max_seq_len "
+                    f"{self.max_seq_len} (cursor {int(cursor)} + chunk "
+                    f"{s}); the KV cache and wpe table end there"
+                )
+            pos = jax.lax.dynamic_slice(wpe, (cursor, 0),
                                         (s, self.hidden_dim))
+            pos = jnp.where(overrun, jnp.nan, pos)
             if initialized:
-                pos_var.value = pos_var.value + s
+                pos_var.value = cursor + s
         else:
             pos = wpe[:s]
         x = wte[tokens].astype(self.dtype) + pos.astype(self.dtype)
         if self.dropout:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        from tpudist.remat import remat_module
+
+        block_policy = self.remat_policy or (
+            "full" if self.remat_layers else None
+        )
         if self.scan_layers:
             if decode:
                 raise ValueError(
@@ -231,7 +258,7 @@ class GPT2(nn.Module):
                 )
             if self.num_experts:
                 raise ValueError("scan_layers supports dense blocks only")
-            body = nn.remat(_CarryBlock) if self.remat_layers else _CarryBlock
+            body = remat_module(_CarryBlock, block_policy)
             scanned = nn.scan(
                 body,
                 variable_axes={"params": 0},
@@ -246,17 +273,25 @@ class GPT2(nn.Module):
             x, _ = scanned(x, None)
         elif self.remat_layers:
             raise ValueError("remat_layers requires scan_layers=True "
-                             "(use make_train_step(remat=True) to checkpoint "
-                             "an unrolled forward)")
+                             "(set remat_policy to checkpoint the unrolled "
+                             "blocks, or make_train_step(remat=...) for a "
+                             "whole-forward checkpoint)")
         else:
+            # per-block checkpoint in the unrolled layout too: h_{i} param
+            # names unchanged (nn.remat is name-transparent), train/decode/
+            # max_len static (they steer python-level structure)
+            block_cls = (
+                remat_module(Block, block_policy, static_argnums=(2, 3, 4))
+                if not decode else Block
+            )
             for i in range(self.depth):
                 moe_here = self.num_experts > 0 and (i % self.moe_every == self.moe_every - 1)
-                x = Block(
+                x = block_cls(
                     self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
                     num_experts=self.num_experts if moe_here else 0,
                     moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
                     mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
-                )(x, train=train, decode=decode, max_len=self.max_seq_len)
+                )(x, train, decode, self.max_seq_len)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head

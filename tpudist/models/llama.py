@@ -234,8 +234,14 @@ class Llama(nn.Module):
     # remat_layers=True checkpoints each scanned layer: backward stores only
     # the per-layer boundary activations and recomputes inside the layer —
     # the scan+remat memory pattern that makes depth-32+ long-sequence
-    # training fit (requires scan_layers)
+    # training fit (requires scan_layers; legacy sugar for
+    # remat_policy="full")
     remat_layers: bool = False
+    # per-BLOCK rematerialization policy (tpudist.remat names: "full",
+    # "dots_saveable", "save_nothing"; None/"none" off), honored in BOTH
+    # the scanned and unrolled layouts (unrolled keeps layer_{i} param
+    # names — nn.remat is name-transparent). Ignored on the decode path.
+    remat_policy: str | None = None
     # num_experts > 0: every moe_every-th block is Mixtral-style MoE (SwiGLU
     # experts over the 'expert' mesh axis, tpudist.parallel.ep); aux
     # load-balance losses are sowed and added by the train step
@@ -268,6 +274,11 @@ class Llama(nn.Module):
             rope_theta=self.rope_theta, mesh=self.mesh,
             norm_eps=self.norm_eps,
         )
+        from tpudist.remat import remat_module
+
+        block_policy = self.remat_policy or (
+            "full" if self.remat_layers else None
+        )
         if self.scan_layers:
             if decode:
                 raise ValueError(
@@ -276,7 +287,7 @@ class Llama(nn.Module):
                 )
             if self.num_experts:
                 raise ValueError("scan_layers supports dense blocks only")
-            body = nn.remat(_CarryBlock) if self.remat_layers else _CarryBlock
+            body = remat_module(_CarryBlock, block_policy)
             scanned = nn.scan(
                 body,
                 variable_axes={"params": 0},
@@ -289,20 +300,27 @@ class Llama(nn.Module):
             x, _ = scanned(x, None)
         elif self.remat_layers:
             raise ValueError("remat_layers requires scan_layers=True "
-                             "(use make_train_step(remat=True) to checkpoint "
-                             "an unrolled forward)")
+                             "(set remat_policy to checkpoint the unrolled "
+                             "blocks, or make_train_step(remat=...) for a "
+                             "whole-forward checkpoint)")
         else:
+            # per-block checkpoint in the unrolled layout: layer_{i} param
+            # names unchanged; train/decode/max_len static under the remat
+            block_cls = (
+                remat_module(LlamaBlock, block_policy, static_argnums=(2, 3, 4))
+                if not decode else LlamaBlock
+            )
             for i in range(self.depth):
                 moe_here = self.num_experts > 0 and (
                     i % self.moe_every == self.moe_every - 1
                 )
-                x = LlamaBlock(
+                x = block_cls(
                     **block_cfg,
                     num_experts=self.num_experts if moe_here else 0,
                     moe_top_k=self.moe_top_k,
                     capacity_factor=self.capacity_factor,
                     name=f"layer_{i}",
-                )(x, train=train, decode=decode, max_len=self.max_seq_len)
+                )(x, train, decode, self.max_seq_len)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")(x)
         if return_hidden:
             # the chunked-CE path applies the head per sequence chunk so the

@@ -272,6 +272,27 @@ class T5(nn.Module):
             pos = pos_var.value
             if initialized:
                 pos_var.value = pos + tok.shape[1]
+            # OVERRUN GUARD: past max_decode_len, dynamic_slice (the bias
+            # rows below) and the caches' dynamic_update_slice CLAMP
+            # silently — wrong relative biases and a clobbered last cache
+            # slot. generate_seq2seq bounds-checks at entry; a direct
+            # incremental-decode caller must fail loudly instead of
+            # decoding garbage: eagerly (concrete cursor) that's a
+            # ValueError; under jit the step's logits are NaN-poisoned —
+            # deterministic, unmissable, and free when the bound holds.
+            if tok.shape[1] > dmax:
+                raise ValueError(
+                    f"decode chunk of {tok.shape[1]} tokens exceeds "
+                    f"max_decode_len {dmax} (the decoder KV-cache buffer)"
+                )
+            overrun = pos + tok.shape[1] > dmax
+            if not isinstance(pos, jax.core.Tracer):
+                if bool(overrun):
+                    raise ValueError(
+                        f"incremental decode past max_decode_len {dmax} "
+                        f"(cursor {int(pos)} + chunk {tok.shape[1]}); "
+                        "grow max_decode_len or stop the decode loop"
+                    )
             # full static [H, Dmax, Dmax] causal bias table (XLA folds the
             # bucket iota); rows pos..pos+s-1 sliced at the traced
             # position — one row per chunk token, so multi-token chunks
@@ -280,6 +301,7 @@ class T5(nn.Module):
             bias = jax.lax.dynamic_slice(
                 table, (0, pos, 0), (self.num_heads, tok.shape[1], dmax)
             )
+            bias = jnp.where(overrun, jnp.nan, bias)
             y = wte[tok].astype(self.dtype)
             for i in range(self.dec_depth):
                 y = _DecoderBlock(

@@ -189,7 +189,12 @@ def _struct(shape, dtype, like):
     pipe-manual region, tpudist.parallel.pp) every pallas output must
     declare how it varies over the manual axes or the shard_map's vma
     check rejects the call."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    # old jax has neither jax.typeof nor vma-typed avals — there the plain
+    # struct is always right (no vma check exists to reject it)
+    vma = (
+        getattr(jax.typeof(like), "vma", None)
+        if hasattr(jax, "typeof") else None
+    )
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

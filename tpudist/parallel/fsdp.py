@@ -14,17 +14,24 @@ overlap/scheduling that DeepSpeed/FSDP implement by hand in C++/Python hooks
 falls out of XLA's compilation of the sharded program. The batch is sharded
 over ``(data, fsdp)`` jointly, so the fsdp axis also contributes data
 parallelism (ZeRO semantics: sharded state, DP gradients).
+
+Memory-discipline composition: FSDP shares its two sibling surfaces with
+plain DP rather than growing private ones — the spec rule is
+``tpudist.mesh.largest_divisible_spec`` (the same rule ZeRO-1
+``tpudist.optim.shard_state`` applies over ``data``), and activation
+rematerialization arrives through the SAME named-policy surface every
+strategy uses: ``make_train_step(remat=...)`` / the models'
+``remat_policy`` field (``tpudist.remat``), orthogonal to the state
+shardings this module produces.
 """
 
 from __future__ import annotations
-
-import math
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudist.mesh import FSDP_AXIS
+from tpudist.mesh import FSDP_AXIS, largest_divisible_spec
 
 
 def fsdp_spec(shape, fsdp_size: int, *, min_size: int = 1024) -> P:
@@ -32,16 +39,10 @@ def fsdp_spec(shape, fsdp_size: int, *, min_size: int = 1024) -> P:
 
     Leaves smaller than ``min_size`` elements (biases, BN scales, scalars)
     stay replicated — sharding them buys no memory and costs a collective.
+    (The rule itself lives in :func:`tpudist.mesh.largest_divisible_spec`,
+    shared with the ZeRO-1 optimizer-state sharding over ``data``.)
     """
-    if fsdp_size <= 1 or math.prod(shape) < min_size:
-        return P()
-    candidates = [(d, i) for i, d in enumerate(shape) if d % fsdp_size == 0]
-    if not candidates:
-        return P()
-    _, axis = max(candidates)
-    spec = [None] * len(shape)
-    spec[axis] = FSDP_AXIS
-    return P(*spec)
+    return largest_divisible_spec(shape, FSDP_AXIS, fsdp_size, min_size=min_size)
 
 
 def fsdp_shardings(state, mesh: Mesh, *, min_size: int = 1024):

@@ -45,7 +45,8 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpudist.utils import compat
+from tpudist.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudist.mesh import DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS
@@ -86,7 +87,7 @@ def _pipeline_local(
     ``x_local`` (valid on every stage — the last stage's results are
     ``psum``-broadcast over the ``pipe`` axis).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     nm = x_local.shape[0]
     is_first = stage == 0
@@ -124,7 +125,7 @@ def _pipeline_local(
     # zero carries must match the per-shard compute's varying-manual-axes
     # type or scan rejects the carry signature (same trick as parallel/cp.py):
     # y varies over 'pipe' (axis_index feeds the gating), the zeros don't yet
-    if hasattr(jax.typeof(x_local), "vma"):
+    if hasattr(jax, "typeof") and hasattr(jax.typeof(x_local), "vma"):
         buf0, outs0 = (
             jax.lax.pcast(x, (axis_name,), to="varying") for x in (buf0, outs0)
         )

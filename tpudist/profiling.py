@@ -67,13 +67,17 @@ class WindowedProfiler:
         return self
 
     def _start(self) -> None:
+        from tpudist.utils import compat
+
         Path(self.log_dir).mkdir(parents=True, exist_ok=True)
         options = None
         if self.with_stack:
-            options = jax.profiler.ProfileOptions()
-            options.python_tracer_level = 1
-            options.host_tracer_level = 2
-        jax.profiler.start_trace(self.log_dir, profiler_options=options)
+            # None on old jax (no ProfileOptions): the trace still runs,
+            # just without the python-stack tracer levels
+            options = compat.profile_options(
+                python_tracer_level=1, host_tracer_level=2
+            )
+        compat.start_trace(self.log_dir, options)
         self._tracing = True
 
     def annotate(self, step_num: int):

@@ -91,6 +91,13 @@ def create_train_state(
 
     Same seed on every process ⇒ bit-identical params — the TPU-native
     init-sync replacing DDP's rank-0 broadcast (SURVEY.md §2.5).
+
+    A ZeRO-1 optimizer (``tpudist.optim.shard_state`` — it advertises
+    ``state_shardings``) overrides the metadata-derived (replicated)
+    opt-state placement with its own data-axis shardings, so the Adam
+    mirrors are BORN sharded inside this one compiled init — they never
+    materialize replicated, not even transiently, which is what lets a
+    ~1B-param state fit 16 GB HBM at bring-up.
     """
     if isinstance(rng, int):
         rng = jax.random.key(rng)
@@ -114,7 +121,14 @@ def create_train_state(
 
     if mesh is None:
         return jax.jit(_init)()
-    return jax.jit(_init, out_shardings=state_shardings_from_meta(_boxed, mesh))()
+    shardings = state_shardings_from_meta(_boxed, mesh)
+    if hasattr(tx, "state_shardings"):
+        # ZeRO-1: the optimizer owns its state's placement
+        params_shapes = jax.eval_shape(_boxed).params
+        shardings = shardings.replace(
+            opt_state=tx.state_shardings(params_shapes)
+        )
+    return jax.jit(_init, out_shardings=shardings)()
 
 
 def state_shardings_from_meta(boxed_init_fn, mesh: Mesh):
@@ -178,7 +192,7 @@ def make_train_step(
     input_key: str = "image",
     label_key: str = "label",
     grad_accum: int = 1,
-    remat: bool = False,
+    remat: bool | str = False,
     state_sharding=None,
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
@@ -219,8 +233,13 @@ def make_train_step(
     BASELINE.json config-5 extension; XLA still emits a single fused program
     with one logical all-reduce per step.
 
-    ``remat=True`` wraps the forward in ``jax.checkpoint`` to trade FLOPs
-    for HBM (useful for long-sequence GPT-2).
+    ``remat`` selects an activation-rematerialization policy by name
+    (:mod:`tpudist.remat`): ``"none"``, ``"full"``, ``"dots_saveable"``
+    (save MXU outputs, recompute the elementwise tail — usually the best
+    TPU trade), ``"save_nothing"``; the legacy bool still works
+    (``True`` ≡ ``"full"``). This wraps the WHOLE forward; per-block
+    checkpointing — the stronger memory lever for deep models — is the
+    model zoo's ``remat_policy`` field, same policy names.
     """
     batch_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
@@ -268,8 +287,9 @@ def make_train_step(
                 "stream; use the default forward or a dropout-free model"
             )
         forward = lambda params, stats, batch, step: forward_loss(params, stats, batch)
-    if remat:
-        forward = jax.checkpoint(forward)
+    from tpudist.remat import checkpoint as _remat_checkpoint
+
+    forward = _remat_checkpoint(forward, remat)
 
     grad_fn = jax.value_and_grad(forward, has_aux=True)
 
@@ -378,7 +398,8 @@ def fit(
     input_key: str = "image",
     label_key: str = "label",
     grad_accum: int = 1,
-    remat: bool = False,
+    remat: bool | str = False,
+    shard_opt_state: bool = False,
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
     input_transform: Callable | None = None,
@@ -403,12 +424,26 @@ def fit(
     step it stopped at (same epoch, same position in the sampler's
     deterministic order) — a capability the reference lacks entirely
     (SURVEY.md §5: no save/load; crash = start over).
+
+    ``shard_opt_state=True`` wraps ``tx`` in ZeRO-1 cross-replica
+    optimizer-state sharding (``tpudist.optim.shard_state``): the Adam
+    mirrors live sharded over the ``data`` replicas (~1/world_size per
+    chip, born sharded at init) and XLA decomposes the gradient all-reduce
+    into reduce-scatter → sharded update → params all-gather inside the
+    same compiled step. Combine with ``remat`` (named policy or the
+    models' per-block ``remat_policy``) for the full memory-discipline
+    recipe — the pair is what moves the trainable-size frontier on a
+    16 GB chip (docs/PERF.md §10).
     """
     import itertools
 
     from tpudist.data.loader import prefetch_to_mesh
 
     mesh = mesh or mesh_lib.create_mesh()
+    if shard_opt_state:
+        from tpudist.optim import shard_state as _zero1
+
+        tx = _zero1(tx, mesh)
     world_size = world_size if world_size is not None else jax.device_count()
     global_rank = (
         global_rank if global_rank is not None else jax.process_index()
@@ -482,6 +517,13 @@ def fit(
         "world_size": world_size,
         "grad_accum": grad_accum,
     }
+    if shard_opt_state:
+        # ZeRO-1 changes the opt-state LAYOUT on disk (padded [world, cols]
+        # leaves): resuming it replicated (or at another world size) would
+        # die in orbax with a shape mismatch — make the geometry guard say
+        # so instead. Only recorded when on, so replicated runs' meta (and
+        # their resumability) is unchanged.
+        run_meta["shard_opt_state"] = True
     ckpt = None
     start_step = 0
     losses: list[float] = []
@@ -529,6 +571,13 @@ def fit(
             job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}"
         ) as p:
             print("Start")
+            # live HBM snapshot post-bring-up (params+opt state placed,
+            # no activations yet): the measured side of the pre-compile
+            # budget tpudist.memory reports; silent no-op on backends
+            # without memory_stats (CPU)
+            from tpudist.memory import device_memory_stats
+
+            logger.log_memory(device_memory_stats())
             global_step = start_step
             logger.start_timer()
 

@@ -1,0 +1,69 @@
+"""Version compatibility for the narrow band of jax APIs that moved.
+
+The repo targets current jax (where ``shard_map`` is a top-level export
+with ``check_vma``/``axis_names`` kwargs); the graft container pins an
+older jax (0.4.x) where the same callable lives at
+``jax.experimental.shard_map.shard_map`` with the pre-rename kwargs
+(``check_rep``, ``auto``). One import site per concept lives here so the
+call sites stay written against the CURRENT api and the translation is a
+single, deletable function.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # current jax: top-level export, check_vma/axis_names kwargs
+    from jax import shard_map as _new_shard_map  # type: ignore
+
+    shard_map = _new_shard_map
+except ImportError:  # jax 0.4.x: experimental module, check_rep/auto kwargs
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+                  axis_names=None):
+        # old-name translation: check_vma was check_rep; manual-over-a-
+        # subset (axis_names) was expressed as its complement (auto)
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _old_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, auto=auto,
+        )
+
+
+try:  # public since jax 0.4.x-late; the underscore path covers 0.4.37
+    from jax.ad_checkpoint import saved_residuals  # type: ignore  # noqa: F401
+except ImportError:
+    from jax._src.ad_checkpoint import saved_residuals  # noqa: F401
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        """Size of a manual-mesh axis inside shard_map — old-jax spelling
+        (a psum of 1 lowers to a constant, same as the new primitive)."""
+        return jax.lax.psum(1, axis_name)
+
+
+def profile_options(python_tracer_level: int, host_tracer_level: int):
+    """``jax.profiler.ProfileOptions`` configured, or None where the class
+    doesn't exist yet (old jax: ``start_trace`` takes no options — the
+    caller must then also omit the kwarg, see :func:`start_trace`)."""
+    if not hasattr(jax.profiler, "ProfileOptions"):
+        return None
+    options = jax.profiler.ProfileOptions()
+    options.python_tracer_level = python_tracer_level
+    options.host_tracer_level = host_tracer_level
+    return options
+
+
+def start_trace(log_dir: str, options=None) -> None:
+    """``jax.profiler.start_trace`` across the profiler_options rename/
+    introduction boundary."""
+    if options is None:
+        jax.profiler.start_trace(log_dir)
+    else:
+        jax.profiler.start_trace(log_dir, profiler_options=options)
