@@ -69,6 +69,10 @@ Legs
    remat (fits); exact pre-compile state accounting via tpudist.memory,
    plus a live sharded-step dryrun on multi-chip attaches
    (docs/PERF.md §10).
+13. ``gpt2_124m_telemetry_overhead_pct`` — the telemetry subsystem's perf
+   contract: the 124M step compiled bare vs with in-step health metrics +
+   the non-finite update guard (interleaved A/B); must stay under 2%
+   step-time overhead (docs/OBSERVABILITY.md).
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -97,7 +101,10 @@ import optax
 
 TARGET_IMG_PER_SEC_PER_CHIP = 2250.0
 TARGET_TOK_PER_SEC_PER_CHIP = 50_000.0
-V5E_BF16_PEAK = 197e12  # one home for the MFU denominators
+# the MFU denominator's one home is tpudist.telemetry.flops (the analytic
+# counters moved there too — a bench leg, examples/mfu_probe.py, and a live
+# fit(telemetry=True) run can no longer disagree about either side)
+from tpudist.telemetry.flops import DEFAULT_PEAK_FLOPS as V5E_BF16_PEAK  # noqa: E402
 
 # Legs run in child processes sharing stdout; each metric line is ALSO
 # appended to this file (path exported by the parent) so the parent can emit
@@ -654,12 +661,15 @@ def bench_gpt2_wide() -> None:
     float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n_steps
 
-    # hand FLOP model (docs/PERF.md §4/§4b accounting), per chip per step
+    # analytic FLOP model (docs/PERF.md §4/§4b accounting, now the shared
+    # counter in tpudist.telemetry.flops), per chip per step
+    from tpudist.telemetry import flops as tflops
+
     t = tokens_per_step / n_chips
-    weight_matmul_params = depth * 12 * hidden * hidden + vocab * hidden
-    gemm_tf = 6.0 * t * weight_matmul_params  # fwd + dgrad + wgrad
-    attn_tf = depth * 12.0 * t * seq_len * hidden  # 6 matmuls/layer
-    mfu = (gemm_tf + attn_tf) / dt / V5E_BF16_PEAK
+    step_flops = tflops.gpt2_train_flops(
+        t, hidden=hidden, depth=depth, vocab=vocab, seq=seq_len
+    )
+    mfu = tflops.mfu(step_flops, dt, peak=V5E_BF16_PEAK)
     _emit_mfu = round(mfu, 4)
     _record_line(
         {
@@ -668,8 +678,9 @@ def bench_gpt2_wide() -> None:
             "unit": "tokens/sec/chip (GPT-2 1536-wide x 12 layers ~419M "
             "params, bf16, seq 1024, 8x2-accum/chip, vmem attention, "
             f"chunk-512 CE); measured MFU {_emit_mfu} of v5e bf16 peak "
-            "(hand FLOP model, PERF §4b); vs_baseline = MFU / 0.60 (the "
-            "width-climb bar)",
+            "(telemetry.flops counter, PERF §4b); vs_baseline = MFU / 0.60 "
+            "(the width-climb bar)",
+            "mfu": _emit_mfu,
             "vs_baseline": round(mfu / 0.60, 4),
         }
     )
@@ -723,24 +734,19 @@ def bench_t5() -> None:
     float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n_steps
 
-    # hand FLOP model per chip per step (same accounting as PERF §4):
-    # fwd GEMMs x3 (fwd + dgrad + wgrad) + attention at 6 matmuls/layer
-    h, ffn, enc_d, dec_d, heads = 512, 1024, 8, 8, 6
+    # analytic FLOP model per chip per step (the shared T5 counter in
+    # tpudist.telemetry.flops — same PERF §4 accounting it was extracted
+    # from: fwd GEMMs x3 + attention at 6 matmuls/layer)
+    from tpudist.telemetry import flops as tflops
+
     te = b * enc_len / n_chips
     td = b * dec_len / n_chips
-    attn_p, mlp_p = 4 * h * h, 3 * h * ffn
-    gemm = 3.0 * 2.0 * (
-        te * enc_d * (attn_p + mlp_p)              # encoder blocks
-        + td * dec_d * (attn_p + mlp_p)            # decoder self+mlp
-        + dec_d * (2 * h * h * td + 2 * h * h * te)  # cross-attn q,o / k,v
-        + td * vocab * h                           # un-tied head
+    step_flops = tflops.t5_train_flops(
+        te, td, hidden=model.hidden_dim, ffn_dim=model.ffn_dim,
+        enc_depth=model.enc_depth, dec_depth=model.dec_depth, vocab=vocab,
+        enc_len=enc_len, dec_len=dec_len,
     )
-    attn = 6.0 * 2.0 * (
-        te * enc_len * h * enc_d                   # encoder self
-        + td * dec_len * h * dec_d                 # decoder self
-        + td * enc_len * h * dec_d                 # cross
-    )
-    mfu = (gemm + attn) / dt / V5E_BF16_PEAK
+    mfu = tflops.mfu(step_flops, dt, peak=V5E_BF16_PEAK)
     tok_s = (te + td) / dt
     _record_line(
         {
@@ -750,8 +756,9 @@ def bench_t5() -> None:
             "geometry, vocab 32128, span-corruption shapes "
             f"enc {enc_len}/dec {dec_len} from a {window}-token window, "
             f"batch 64/chip, bf16); measured MFU {round(mfu, 4)} of v5e "
-            "bf16 peak (hand FLOP model); vs_baseline = MFU (fraction of "
-            "the FLOP roofline)",
+            "bf16 peak (telemetry.flops counter); vs_baseline = MFU "
+            "(fraction of the FLOP roofline)",
+            "mfu": round(mfu, 4),
             "vs_baseline": round(mfu, 4),
         }
     )
@@ -765,6 +772,7 @@ def bench_families() -> None:
     from tpudist import mesh as mesh_lib
     from tpudist.models.bert import Bert, mlm_forward, mlm_transform
     from tpudist.models.llama import llama_125m
+    from tpudist.telemetry import flops as tflops
     from tpudist.train import create_train_state, lm_loss, make_train_step
 
     n_chips = jax.device_count()
@@ -782,14 +790,16 @@ def bench_families() -> None:
             state, metrics = step(state, next(batches))
         float(metrics["loss"])
         dt = (time.perf_counter() - t0) / n_steps
-        mfu = flops / dt / V5E_BF16_PEAK
+        mfu = tflops.mfu(flops, dt, peak=V5E_BF16_PEAK)
         _record_line(
             {
                 "metric": f"{model_name}_tokens_per_sec_per_chip",
                 "value": round(tokens_per_step / dt / n_chips, 2),
                 "unit": f"tokens/sec/chip ({config_note}); measured MFU "
-                f"{round(mfu, 4)} of v5e bf16 peak (hand FLOP model); "
-                "vs_baseline = MFU (fraction of the FLOP roofline)",
+                f"{round(mfu, 4)} of v5e bf16 peak (telemetry.flops "
+                "counter); vs_baseline = MFU (fraction of the FLOP "
+                "roofline)",
+                "mfu": round(mfu, 4),
                 "vs_baseline": round(mfu, 4),
             }
         )
@@ -821,9 +831,10 @@ def bench_families() -> None:
         for _ in range(n_steps + 3)
     ])
     t = seqs * seq / n_chips
-    dh = d // 12
-    layer_p = 2 * d * d + 2 * d * (kv_heads * dh) + 3 * d * ffn
-    flops = 6.0 * t * (depth * layer_p + vocab * d) + depth * 12.0 * t * seq * d
+    flops = tflops.llama_train_flops(
+        t, hidden=d, depth=depth, ffn_dim=ffn, vocab=vocab, seq=seq,
+        num_heads=12, num_kv_heads=kv_heads,
+    )
     drive("llama_125m", state, step, batches, seqs * seq, flops,
           "Llama-125M: RoPE/RMSNorm/SwiGLU, GQA 12/4, bf16, seq 1024, "
           "8x4-accum/chip, vmem attention")
@@ -845,11 +856,9 @@ def bench_families() -> None:
         for _ in range(n_steps + 3)
     ])
     bt = bbatch * bseq / n_chips
-    bd = bmodel.hidden_dim
-    # block GEMMs 12·d² per layer + MLM head (d² transform + tied V·d)
-    bflops = (
-        6.0 * bt * (12 * 12 * bd * bd + bd * bd + bvocab * bd)
-        + 12 * 12.0 * bt * bseq * bd
+    bflops = tflops.bert_train_flops(
+        bt, hidden=bmodel.hidden_dim, depth=bmodel.depth, vocab=bvocab,
+        seq=bseq,
     )
     drive("bert_base_mlm", bstate, bstep, bbatches, bbatch * bseq, bflops,
           "BERT-base MLM (80/10/10 corruption), bf16, seq 512, batch "
@@ -1152,6 +1161,88 @@ def _attach_alive(timeout_s: float = 240.0) -> bool:
         return False
 
 
+def bench_telemetry_overhead() -> None:
+    """The telemetry subsystem's perf contract (docs/OBSERVABILITY.md): the
+    SAME GPT-2 124M train step compiled twice — bare, and with the in-step
+    health metrics + non-finite update guard
+    (``make_train_step(telemetry=True, guard_nonfinite=True)``). The claim
+    to hold: the norms/counts are reductions XLA fuses into the existing
+    backward pass, so the telemetry step keeps >= 98% of the bare step's
+    throughput (< 2% step-time overhead). Interleaved A/B (bare/telemetry
+    alternating windows) so attach drift lands on both sides. value = the
+    overhead in percent; vs_baseline = (telemetry rate / bare rate) / 0.98
+    — >= 1.0 means the < 2% bound is met with margin."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    seq_len, micro_per_chip, grad_accum = 1024, 8, 4
+    seqs_per_step = micro_per_chip * grad_accum * n_chips
+    tokens_per_step = seqs_per_step * seq_len
+
+    model = GPT2(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
+    tx = optax.adam(1e-3)
+
+    def build(telemetry: bool):
+        state = create_train_state(
+            model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", grad_accum=grad_accum,
+            forward_loss=chunked_lm_forward(model, chunk=512),
+            telemetry=telemetry, guard_nonfinite=telemetry,
+        )
+        return state, step
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    n_rounds, window = 4, 8
+    batches = [
+        rng.integers(0, 50257, (seqs_per_step, seq_len)).astype(np.int32)
+        for _ in range(window)
+    ]
+
+    sides = {name: build(name == "telemetry") for name in ("bare", "telemetry")}
+    times = {"bare": 0.0, "telemetry": 0.0}
+    for name, (state, step) in sides.items():  # compile + warmup
+        for b in batches[:3]:
+            state, metrics = step(state, {"tokens": b})
+        jax.block_until_ready(metrics["loss"])
+        sides[name] = (state, step)
+    for _ in range(n_rounds):
+        for name in ("bare", "telemetry"):
+            state, step = sides[name]
+            t0 = time.perf_counter()
+            for b in batches:
+                state, metrics = step(state, {"tokens": b})
+            float(metrics["loss"])
+            times[name] += time.perf_counter() - t0
+            sides[name] = (state, step)
+
+    steps_per_side = n_rounds * window
+    rate = {k: tokens_per_step * steps_per_side / v / n_chips
+            for k, v in times.items()}
+    overhead_pct = 100.0 * (times["telemetry"] - times["bare"]) / times["bare"]
+    _record_line(
+        {
+            "metric": "gpt2_124m_telemetry_overhead_pct",
+            "value": round(overhead_pct, 3),
+            "unit": "percent step-time overhead of in-step health metrics "
+            "(grad/param/update norms + non-finite count) + the non-finite "
+            f"update guard on the GPT-2 124M step: "
+            f"{round(rate['bare'], 1)} bare vs "
+            f"{round(rate['telemetry'], 1)} telemetry tok/s/chip "
+            "(interleaved A/B); vs_baseline = (telemetry rate / bare rate) "
+            "/ 0.98 — >= 1.0 meets the < 2% bound (docs/OBSERVABILITY.md)",
+            "telemetry_rate_tok_s_chip": round(rate["telemetry"], 2),
+            "bare_rate_tok_s_chip": round(rate["bare"], 2),
+            "vs_baseline": round(rate["telemetry"] / rate["bare"] / 0.98, 4),
+        }
+    )
+
+
 # leg groups: (function, wall-clock budget in seconds). Budgets are ~3x the
 # healthy-attach duration of each group, so they only fire on a wedge.
 _LEG_GROUPS = {
@@ -1166,6 +1257,8 @@ _LEG_GROUPS = {
     # budgets are eval_shape-only (seconds); the generous cap covers the
     # optional multi-chip dryrun step's compile
     "memory": (bench_memory_discipline, 1500),
+    # two compiles of the 124M step + 2x4x8 measured steps
+    "telemetry": (bench_telemetry_overhead, 1800),
 }
 
 

@@ -24,14 +24,23 @@ Run on the bench chip::
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-# v5e bf16 peak; override with --peak for other chips
-DEFAULT_PEAK_FLOPS = 197e12
+# runnable as a plain script from anywhere: put the repo root (one level up)
+# on sys.path when tpudist isn't pip-installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# single source of truth for the analytic counters, the GEMM-shape table,
+# and the peak default (tpudist.telemetry.flops): this file keeps only the
+# CLI and the on-chip timing harness — the math it times lives with the
+# MFU accounting that fit()'s telemetry and bench.py's legs share
+from tpudist.telemetry.flops import DEFAULT_PEAK_FLOPS, gpt2_step_shapes  # noqa: E402
 
 
 def time_gemm(m: int, k: int, n: int, *, reps: int = 5,
@@ -84,26 +93,6 @@ def time_gemm(m: int, k: int, n: int, *, reps: int = 5,
             return fl
         iters = min(iters * 2, 16384)
     return float("nan")  # persistently noisy; rendered as nan, never fake
-
-
-def gpt2_step_shapes(tokens: int, hidden: int, vocab: int = 50257,
-                     ce_chunk_rows: int = 4096) -> list[tuple[str, int, int, int]]:
-    """The GEMM shapes of one GPT-2 block + tied head, forward and the two
-    backward passes (dgrad/wgrad) per GEMM, at ``tokens`` rows."""
-    t, d = tokens, hidden
-    fwd = [
-        ("qkv", t, d, 3 * d),
-        ("attn_out", t, d, d),
-        ("mlp_fc", t, d, 4 * d),
-        ("mlp_proj", t, 4 * d, d),
-        ("lm_head(chunk)", ce_chunk_rows, d, vocab),
-    ]
-    shapes = []
-    for name, m, k, n in fwd:
-        shapes.append((f"{name} fwd", m, k, n))
-        shapes.append((f"{name} dgrad", m, n, k))
-        shapes.append((f"{name} wgrad", k, m, n))
-    return shapes
 
 
 def main() -> None:

@@ -140,6 +140,12 @@ def parse_args(argv=None):
     p.add_argument("--val_tokens", default=None, type=str,
                    help="held-out token file (.npy/.bin) for --eval")
     p.add_argument("--no_profiler", action="store_true")
+    p.add_argument("--telemetry", action="store_true",
+                   help="observability subsystem (docs/OBSERVABILITY.md): "
+                   "in-step grad/param/update norms + non-finite update "
+                   "guard, NaN/divergence sentry with on-demand trace "
+                   "capture, step-time breakdown, MFU rows — JSONL stream "
+                   "next to the reference TSV")
     p.add_argument("--log_dir", default=".", type=str)
     p.add_argument("--checkpoint_dir", default=None, type=str)
     p.add_argument("--checkpoint_every", default=0, type=int)
@@ -408,6 +414,7 @@ def main(argv=None):
             shard_opt_state=args.shard_opt_state,
             batch_spec=batch_spec, forward_loss=fwd_loss,
             profile=not args.no_profiler, log_dir=args.log_dir,
+            telemetry=args.telemetry,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             resume=not args.no_resume,

@@ -17,6 +17,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # some environments ship a sitecustomize that force-registers a TPU plugin
 # and rewrites jax_platforms; pin it back to cpu before any backend spins up
@@ -84,3 +85,50 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
         if item.fspath.basename in _SMOKE_FILES and "slow" not in item.keywords:
             item.add_marker(_pytest.mark.smoke)
+
+
+def tiny_resnet():
+    """2-stage/1-block/8-filter ResNet: same BN + residual + strided-stage
+    topology as resnet18 at a fraction of the compile bill. The shared
+    helper for compile-heavy ResNet tests — test_device_cache.py compiles
+    each data path as its own program, and test_amp_optim.py's guard test
+    runs cache-less every time (see no_persistent_compile_cache), so the
+    geometry must stay identical between them."""
+    from tpudist.models.resnet import ResNet, ResNetBlock
+
+    return ResNet(stage_sizes=[1, 1], num_filters=8, block_cls=ResNetBlock,
+                  num_classes=10, small_inputs=True)
+
+
+@pytest.fixture
+def no_persistent_compile_cache():
+    """Disable the persistent compilation cache for ONE test.
+
+    Second documented wart of the cache's AOT round trip on this XLA:CPU
+    (the first is the bert ring-collective SIGABRT above): an executable
+    LOADED from the persistent cache has been observed to misexecute the
+    select-guarded optimizer-update pattern (``jnp.where(ok, new, old)``
+    over donated state: the post-skip clean step leaves params frozen —
+    measured failing with the cache, passing without, tpudist.telemetry's
+    guard tests and test_amp_optim's), and a cache HIT emits no compile
+    log at all, starving ``jax.log_compiles`` assertions. Tests touching
+    either pattern opt out here; everything else keeps the >1h-saving
+    cache.
+
+    Flipping ``jax_compilation_cache_dir`` alone is NOT enough: the cache
+    object is a process-lifetime singleton (``_initialize_cache`` runs at
+    most once and never re-reads the config), so once any earlier test
+    compiled anything, the config update is silently ignored. The
+    singleton must be reset around the config change — and reset again on
+    exit so the restored dir takes effect for the next test.
+    """
+    from jax._src import compilation_cache as _cc
+
+    old = jax.config.jax_compilation_cache_dir
+    _cc.reset_cache()
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+        _cc.reset_cache()

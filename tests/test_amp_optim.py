@@ -11,6 +11,9 @@ from tpudist.amp import BF16_COMPUTE, all_finite, policy_for, skip_nonfinite, sk
 from tpudist.optim import make_optimizer, decay_mask, warmup_cosine
 
 
+from conftest import tiny_resnet as _tiny_resnet
+
+
 def test_policy_casts_floats_only():
     tree = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
     out = BF16_COMPUTE.cast_to_compute(tree)
@@ -73,17 +76,18 @@ def test_skip_nonfinite_trains_through_a_spike():
     assert abs(float(params[0])) < 2.0  # the finite steps made progress
 
 
-def test_inf_batch_trips_guard_in_compiled_step():
+def test_inf_batch_trips_guard_in_compiled_step(
+    no_persistent_compile_cache,
+):
     """A synthetic inf in the batch produces non-finite grads INSIDE the
     compiled train step; the guard must skip that update (params
     bit-identical, counter=1) and recover on the next clean batch."""
     from tpudist import mesh as mesh_lib
     from tpudist.data.cifar import synthetic_cifar, to_tensor
-    from tpudist.models import resnet18
     from tpudist.train import create_train_state, make_train_step
 
     mesh = mesh_lib.create_mesh()
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
     tx = make_optimizer(1e-3, skip_nonfinite_updates=True)
     state = create_train_state(model, 0, jnp.zeros((1, 32, 32, 3)), tx, mesh)
     step = make_train_step(model, tx, mesh)
@@ -173,12 +177,11 @@ def test_make_optimizer_in_train_step():
     """The full factory chain (clip + adamw + skip_nonfinite) drives the
     compiled train step."""
     from tpudist import mesh as mesh_lib
-    from tpudist.models import resnet18
     from tpudist.data.cifar import synthetic_cifar, to_tensor
     from tpudist.train import create_train_state, make_train_step
 
     mesh = mesh_lib.create_mesh()
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
     tx = make_optimizer(
         warmup_cosine(1e-3, warmup_steps=2, total_steps=20),
         weight_decay=1e-4, clip_norm=1.0, skip_nonfinite_updates=True,

@@ -20,8 +20,10 @@ from tpudist.data.device_cache import DeviceCachedLoader
 from tpudist.data.loader import DataLoader
 from tpudist.data.sampler import DistributedSampler
 from tpudist.data.transforms import device_normalize
-from tpudist.models import resnet18
 from tpudist.train import create_train_state, fit, make_train_step
+
+
+from conftest import tiny_resnet as _tiny_resnet
 
 
 def _dataset(n=96, seed=0):
@@ -38,7 +40,7 @@ def test_matches_host_uint8_loader():
     sequence."""
     data = _dataset()
     mesh = mesh_lib.create_mesh()
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
     norm = device_normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
 
     def run(cached: bool):
@@ -72,7 +74,7 @@ def test_matches_host_uint8_loader():
 def test_fit_runs_with_cached_loader(tmp_path):
     data = _dataset(n=64, seed=1)
     mesh = mesh_lib.create_mesh()
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
     loader = DeviceCachedLoader(data, 16, mesh=mesh)
     norm = device_normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
     state, losses = fit(
@@ -104,7 +106,7 @@ def test_evaluate_through_cached_loader():
 
     data = _dataset(n=48, seed=5)
     mesh = mesh_lib.create_mesh()
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
     state = create_train_state(
         model, 0, jnp.zeros((1, 16, 16, 3)), optax.adam(1e-3), mesh
     )
@@ -135,7 +137,7 @@ def test_grad_accum_with_cached_loader():
     accumulated run must match the host loader's accumulated run."""
     data = _dataset(n=64, seed=7)
     mesh = mesh_lib.create_mesh()
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
     norm = device_normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
 
     def run(cached: bool):
@@ -330,7 +332,7 @@ def test_rotating_cache_fit_trains_and_resumes(tmp_path):
     mesh = mesh_lib.create_mesh()
     data = synthetic_cifar(n=64, num_classes=10)
     rot = RotatingDeviceCache(data, 8, shard_rows=32, mesh=mesh)
-    model = resnet18(num_classes=10, small_inputs=True)
+    model = _tiny_resnet()
 
     def run(epochs, ckdir):
         return fit(

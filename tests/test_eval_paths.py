@@ -63,7 +63,9 @@ def test_padded_batches_constant_shape():
     assert real == 39
 
 
-def test_evaluate_compiles_once_despite_ragged_tail(caplog):
+def test_evaluate_compiles_once_despite_ragged_tail(
+    caplog, no_persistent_compile_cache,
+):
     model = _tiny_model()
     mesh = mesh_lib.create_mesh()
     state = create_train_state(
@@ -75,7 +77,11 @@ def test_evaluate_compiles_once_despite_ragged_tail(caplog):
             evaluate(model, state, loader(), mesh)
     compiles = [
         r for r in caplog.records
-        if r.getMessage().startswith("Compiling jit(count_correct)")
+        # message format varies across jax versions: "Compiling
+        # jit(count_correct)" vs "Compiling count_correct with global
+        # shapes" — match the invariant part
+        if r.getMessage().startswith("Compiling")
+        and "count_correct" in r.getMessage()
     ]
     assert len(compiles) == 1, [r.getMessage() for r in compiles]
 

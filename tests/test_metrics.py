@@ -68,6 +68,41 @@ def test_hbm_row_rank0_only_and_noop_without_stats(tmp_path):
     )
 
 
+def test_zero_duration_row_is_tagged_not_inf(tmp_path):
+    """A coarse clock under a sub-resolution CPU step hands log_step a
+    duration of 0: the reference's ``batch_size / step_duration`` would be
+    a ZeroDivisionError. The row must land with 0.0 throughput under a
+    ``ZeroDur`` tag (footer-style, so plain data rows keep the guarantee
+    that examples_per_sec is a real measurement) — and mirror the same
+    values into the JSONL sink in dual-sink mode."""
+    import json
+
+    class _Sink:
+        rows = []
+
+        def write(self, kind, step=None, **fields):
+            self.rows.append({"kind": kind, "step": step, **fields})
+
+    logger = MetricsLogger("J", 64, 0, 1, log_dir=tmp_path)
+    logger.attach_sink(_Sink())
+    logger.log_step(5, loss_value=2.5, step_duration=0.0)
+    logger.log_step(10, loss_value=2.0, step_duration=0.5)
+    logger.finish()
+    lines = logger.file_name.read_text().splitlines()
+    tagged = [l for l in lines if l.startswith("ZeroDur\t")]
+    assert len(tagged) == 1
+    fields = tagged[0].split("\t")
+    # tag + the reference's five columns, throughput pinned to 0.0
+    assert len(fields) == 6 and float(fields[5]) == 0.0
+    # the clean row is untagged and keeps the real measurement
+    clean = [l for l in lines[1:] if not l.startswith(("ZeroDur", "TrainTime"))]
+    assert len(clean) == 1 and abs(float(clean[0].split("\t")[4]) - 128) < 1e-6
+    jsonl = [r for r in _Sink.rows if r["kind"] == "throughput"]
+    assert [r["zero_duration"] for r in jsonl] == [True, False]
+    assert jsonl[0]["examples_per_sec"] == 0.0
+    json.dumps(jsonl)  # rows stay JSON-serializable
+
+
 def test_traintime_footer_format(tmp_path):
     logger = MetricsLogger("J", 1, 0, 1, log_dir=tmp_path)
     t = logger.finish()

@@ -1,0 +1,198 @@
+"""fit()-level telemetry integration: the JSONL stream's row kinds, the
+NaN flight recorder end-to-end (in-graph skip → sentry event → armed trace
+window), the automatic HBM-row cadence, and — the contract the whole
+subsystem hangs off — the reference TSV staying byte-identical in format
+when telemetry is off."""
+
+import json
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpudist.data.loader import DataLoader
+from tpudist.models.gpt2 import GPT2
+from tpudist.telemetry import TelemetryConfig
+from tpudist.train import fit, lm_loss
+
+VOCAB = 256
+POISON = 255  # the sentinel token the poisoned loss turns into NaN
+
+
+def _tiny_lm():
+    return GPT2(vocab_size=VOCAB, max_seq_len=16, hidden_dim=32, depth=1,
+                num_heads=2)
+
+
+def _loader(poison_row: int | None = None, n: int = 64, batch: int = 16):
+    rng = np.random.Generator(np.random.PCG64(0))
+    tokens = rng.integers(0, POISON - 1, (n, 16)).astype(np.int32)
+    if poison_row is not None:
+        tokens[poison_row, 0] = POISON
+    return DataLoader({"tokens": tokens}, batch)
+
+
+def _poisoned_loss(logits, tokens):
+    base = lm_loss(logits, tokens)
+    return jnp.where(jnp.any(tokens == POISON), jnp.float32(jnp.nan), base)
+
+
+def _rows(path):
+    return [json.loads(l) for l in pathlib.Path(path).read_text().splitlines()]
+
+
+def test_fit_telemetry_stream_has_all_row_kinds(tmp_path):
+    cfg = TelemetryConfig(heartbeat_every=4)
+    state, losses = fit(
+        _tiny_lm(), optax.adam(1e-3), _loader(), epochs=3, job_id="TS",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path), telemetry=cfg,
+        profile=False,
+    )
+    assert len(losses) == 12 and all(np.isfinite(losses))
+    rows = _rows(tmp_path / "TS_telemetry_0.jsonl")
+    kinds = {r["kind"] for r in rows}
+    # the acceptance triple: grad-norm, MFU, and step-breakdown rows
+    assert {"run_meta", "health", "mfu", "step_breakdown", "throughput",
+            "heartbeat", "run_summary", "train_time"} <= kinds
+    assert all(r["v"] == 1 and r["rank"] == 0 for r in rows)
+
+    health = [r for r in rows if r["kind"] == "health"]
+    # log_every=5 cadence over 12 steps → steps 5 and 10
+    assert [r["step"] for r in health] == [5, 10]
+    for r in health:
+        assert r["grad_norm"] > 0 and r["param_norm"] > 0
+        assert r["nonfinite_grad_count"] == 0 and r["update_skipped"] == 0
+        # counts are documented as integers: the host resolve must not
+        # float()-launder them into 0.0
+        assert isinstance(r["nonfinite_grad_count"], int)
+        assert isinstance(r["update_skipped"], int)
+
+    mfu = [r for r in rows if r["kind"] == "mfu"]
+    assert [r["step"] for r in mfu] == [5, 10]
+    from tpudist.telemetry import flops
+
+    want = flops.gpt2_train_flops(
+        16.0 * 16, hidden=32, depth=1, vocab=VOCAB, seq=16
+    )
+    for r in mfu:
+        assert r["flops_per_step"] == want
+        assert r["mfu"] > 0 and r["tokens_per_sec"] > 0
+
+    bd = [r for r in rows if r["kind"] == "step_breakdown"]
+    assert [r["step"] for r in bd] == [5, 10]
+    for r in bd:
+        assert r["interval_s"] > 0 and r["dispatch_s"] > 0
+        assert r["data_wait_s"] >= 0
+        assert r["device_s"] is not None and r["device_s"] > 0
+
+    beats = [r for r in rows if r["kind"] == "heartbeat"]
+    assert [r["step"] for r in beats] == [4, 8, 12]
+
+    summary = [r for r in rows if r["kind"] == "run_summary"]
+    assert len(summary) == 1 and summary[0]["anomaly_events"] == 0
+    # the sink is ordered: train_time (the logger's mirrored footer) is last
+    assert rows[-1]["kind"] == "train_time" and rows[-1]["seconds"] > 0
+
+
+def test_fit_nan_flight_recorder_end_to_end(tmp_path):
+    """Injected NaN: the in-graph guard skips the update, training
+    continues finite, the sentry logs one structured anomaly per poisoned
+    epoch pass, and the profiler captures an on-demand window."""
+    # row 36 lands in batch index 2 of every epoch (rows 32..47)
+    state, losses = fit(
+        _tiny_lm(), optax.adam(1e-3), _loader(poison_row=36), epochs=2,
+        job_id="NA", batch_size=16, loss_fn=_poisoned_loss,
+        input_key="tokens", label_key="tokens", log_dir=str(tmp_path),
+        telemetry=TelemetryConfig(capture_steps=2, cooldown_steps=1),
+        profile=True,
+    )
+    # steps 3 and 7 are the poisoned ones: loss NaN, everything else finite
+    assert len(losses) == 8
+    assert not np.isfinite(losses[2]) and not np.isfinite(losses[6])
+    finite = [l for i, l in enumerate(losses) if i not in (2, 6)]
+    assert all(np.isfinite(finite))
+    # the skipped update did not poison params: later losses keep improving
+    assert finite[-1] < finite[0]
+
+    rows = _rows(tmp_path / "NA_telemetry_0.jsonl")
+    anomalies = [r for r in rows if r["kind"] == "anomaly"]
+    assert [a["step"] for a in anomalies] == [3, 7]
+    for a in anomalies:
+        assert a["event"] == "nonfinite"
+        assert a["loss"] is None  # NaN serialized as null, strict JSON
+        assert a["update_skipped"] == 1
+        assert a["profiler_armed"] is True
+    summary = next(r for r in rows if r["kind"] == "run_summary")
+    assert summary["anomaly_events"] == 2
+
+    # a trace window was captured (scheduled and/or armed; sub-second
+    # windows may share one timestamped dir — same caveat as
+    # test_profiling.py)
+    profile_root = tmp_path / "log_NA" / "plugins" / "profile"
+    assert profile_root.exists() and any(
+        f.suffix == ".pb" for d in profile_root.iterdir() for f in d.rglob("*")
+    )
+
+
+def test_fit_telemetry_off_keeps_reference_tsv_contract(tmp_path):
+    """telemetry=False (the default): no JSONL stream exists, and the TSV
+    holds ONLY the reference contract's lines — header, data rows, the
+    HBM/TrainTime tagged footers. Byte-format compatibility is what the
+    baseline comparison tooling parses."""
+    from tpudist.metrics import HEADER
+
+    fit(
+        _tiny_lm(), optax.adam(1e-3), _loader(), epochs=2, job_id="OFF",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path), profile=False,
+    )
+    assert not list(tmp_path.glob("*telemetry*"))
+    lines = (tmp_path / "OFF_16_0.log").read_text().splitlines()
+    assert lines[0] == HEADER.strip()
+    assert lines[-1].startswith("TrainTime\t")
+    for row in lines[1:-1]:
+        fields = row.split("\t")
+        if fields[0] in ("HBM",):
+            continue
+        # a reference data row: datetime, g_step, g_img, loss, ex/sec
+        assert len(fields) == 5
+        int(fields[1]), int(fields[2])
+        float(fields[3]), float(fields[4])
+
+
+def test_fit_memory_log_cadence_respects_backend(tmp_path):
+    """memory_log_every=None auto-disables on CPU (no allocator stats —
+    zero probe calls), and an explicit cadence still writes nothing where
+    the backend reports nothing (log_memory's own no-op guard)."""
+    from tpudist.memory import device_memory_stats
+
+    assert device_memory_stats() is None  # this suite runs on CPU: auto-off
+    fit(
+        _tiny_lm(), optax.adam(1e-3), _loader(), epochs=1, job_id="MEM",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path), profile=False,
+        memory_log_every=2,
+    )
+    assert "HBM" not in (tmp_path / "MEM_16_0.log").read_text()
+
+
+def test_fit_telemetry_respects_config_toggles(tmp_path):
+    """health_metrics/breakdown/mfu off ⇒ those rows are absent while the
+    sentry still watches the loss stream."""
+    cfg = TelemetryConfig(
+        health_metrics=False, guard_nonfinite=False, breakdown=False,
+        mfu=False,
+    )
+    fit(
+        _tiny_lm(), optax.adam(1e-3), _loader(), epochs=1, job_id="TG",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path), telemetry=cfg,
+        profile=False,
+    )
+    rows = _rows(tmp_path / "TG_telemetry_0.jsonl")
+    kinds = {r["kind"] for r in rows}
+    assert "health" not in kinds and "mfu" not in kinds
+    assert "step_breakdown" not in kinds and "run_meta" not in kinds
+    assert "run_summary" in kinds

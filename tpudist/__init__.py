@@ -28,6 +28,7 @@ from tpudist.data.sampler import DistributedSampler
 from tpudist.store import TCPStore
 from tpudist.amp import Policy, policy_for, skip_nonfinite
 from tpudist.optim import make_optimizer, run_schedule, warmup_cosine
+from tpudist.telemetry import TelemetryConfig
 
 __version__ = "0.1.0"
 
@@ -47,5 +48,6 @@ __all__ = [
     "make_optimizer",
     "run_schedule",
     "warmup_cosine",
+    "TelemetryConfig",
     "__version__",
 ]

@@ -110,3 +110,31 @@ def skip_nonfinite(tx: optax.GradientTransformation) -> optax.GradientTransforma
 def skipped_steps(opt_state) -> int:
     """Read the skip counter out of a :func:`skip_nonfinite` state."""
     return int(opt_state[1])
+
+
+def is_skip_state(opt_state) -> bool:
+    """True when ``opt_state`` is structurally a :func:`skip_nonfinite`
+    state — ``(inner_state, int32 scalar counter)``, the wrapper applied
+    outermost by convention (including under
+    :func:`tpudist.optim.shard_state`, whose counter leaf is replicated).
+    Works on tracers too (shape/dtype are static), which is how
+    ``make_train_step``'s non-finite guard finds the counter leaf to
+    exempt from its opt-state freeze. The ONE structural definition: a
+    future change to the wrapper's state shape is updated here, next to
+    the wrapper, and every reader follows."""
+    if not (isinstance(opt_state, tuple) and len(opt_state) == 2):
+        return False
+    counter = opt_state[1]
+    return (
+        hasattr(counter, "dtype")
+        and getattr(counter, "ndim", None) == 0
+        and jnp.issubdtype(counter.dtype, jnp.integer)
+    )
+
+
+def maybe_skipped_steps(opt_state) -> int | None:
+    """Best-effort :func:`skipped_steps` for chains that may not carry the
+    wrapper: the count, or ``None`` when the chain carries no skip wrapper
+    — the telemetry run-summary row then reports ``null`` instead of
+    fabricating a zero (tpudist.telemetry)."""
+    return skipped_steps(opt_state) if is_skip_state(opt_state) else None

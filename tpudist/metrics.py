@@ -50,6 +50,16 @@ class MetricsLogger:
         self._file.write(HEADER)
         self._file.flush()
         self._train_begin = time.time()
+        self._sink = None
+
+    def attach_sink(self, sink) -> None:
+        """Dual-sink mode (tpudist.telemetry): every row this logger writes
+        — throughput data rows, HBM rows, the TrainTime footer — is ALSO
+        mirrored as a structured JSONL object into ``sink`` (a
+        ``TelemetrySink``). The TSV side is untouched byte-for-byte: the
+        reference contract is what baseline comparisons parse, the JSONL
+        side is what dashboards parse, and neither needs the other."""
+        self._sink = sink
 
     def start_timer(self) -> None:
         """Reset the TrainTime clock (reference starts it just before the
@@ -57,16 +67,38 @@ class MetricsLogger:
         self._train_begin = time.time()
 
     def log_step(self, global_step: int, loss_value: float, step_duration: float) -> None:
-        """Call once per step on every rank; writes on rank 0 at the cadence."""
+        """Call once per step on every rank; writes on rank 0 at the cadence.
+
+        ``step_duration <= 0`` (a coarse clock under a sub-resolution CPU
+        step, or wall-clock skew) would make the reference's
+        ``batch_size / step_duration`` a ZeroDivisionError or an inf row;
+        instead the row is written with ``0.0`` throughput under a
+        ``ZeroDur`` tag — footer-style like ``HBM``/``TrainTime``, so plain
+        data rows keep the guarantee that examples_per_sec is a real
+        measurement."""
         if self.global_rank == 0 and global_step % self.log_every == 0:
-            examples_per_sec = self.batch_size / step_duration
+            degenerate = step_duration <= 0.0
+            examples_per_sec = (
+                0.0 if degenerate else self.batch_size / step_duration
+            )
             row = (
                 f"{datetime.now()}\t{global_step * self.world_size}\t"
                 f"{global_step * self.world_size * self.batch_size}\t"
                 f"{loss_value}\t{examples_per_sec}\n"
             )
+            if degenerate:
+                row = "ZeroDur\t" + row
             self._file.write(row)
             self._file.flush()
+            if self._sink is not None:
+                self._sink.write(
+                    "throughput", global_step,
+                    g_step=global_step * self.world_size,
+                    g_img=global_step * self.world_size * self.batch_size,
+                    loss=loss_value,
+                    examples_per_sec=examples_per_sec,
+                    zero_duration=degenerate,
+                )
 
     def print_progress(self, epoch: int, idx: int, loss_value: float) -> None:
         if self.global_rank == 0 and idx % self.print_every == 0:
@@ -85,11 +117,15 @@ class MetricsLogger:
 
         self._file.write("HBM\t%s\n" % json.dumps(stats, sort_keys=True))
         self._file.flush()
+        if self._sink is not None:
+            self._sink.write("memory", **stats)
 
     def finish(self) -> float:
         train_time = time.time() - self._train_begin
         self._file.write("TrainTime\t%f\n" % train_time)
         self._file.close()
+        if self._sink is not None:
+            self._sink.write("train_time", seconds=round(train_time, 6))
         return train_time
 
     def __enter__(self):

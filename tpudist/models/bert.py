@@ -189,6 +189,12 @@ class Bert(nn.Module):
     scan_layers: bool = False
     remat_layers: bool = False
 
+    @property
+    def flops_counter(self) -> str:
+        """Analytic-FLOPs family tag (tpudist.telemetry.flops): encoder
+        blocks + the MLM head's transform and tied projection."""
+        return "bert"
+
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
                  token_types=None, attention_mask=None):

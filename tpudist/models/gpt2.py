@@ -203,6 +203,14 @@ class GPT2(nn.Module):
     def has_aux_loss(self) -> bool:
         return self.num_experts > 0
 
+    @property
+    def flops_counter(self) -> str | None:
+        """Analytic-FLOPs family tag (tpudist.telemetry.flops) — the MFU
+        numerator dispatch. None for MoE geometries: the dense counter
+        would miscount routed experts, and a wrong MFU is worse than no
+        MFU row."""
+        return None if self.num_experts > 0 else "gpt2"
+
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
                  decode: bool = False):

@@ -209,6 +209,14 @@ class _CarryBlock(nn.Module):
         return x, None
 
 
+def default_ffn_dim(hidden_dim: int) -> int:
+    """The SwiGLU sizing ``ffn_dim=None`` resolves to: 8/3·d rounded up to
+    a multiple of 256 (Llama convention). One home for the formula — the
+    model's forward and the analytic FLOPs dispatcher
+    (tpudist.telemetry.flops) must agree on the parameter count."""
+    return -(-8 * hidden_dim // 3 // 256) * 256
+
+
 class Llama(nn.Module):
     vocab_size: int = 32000
     max_seq_len: int = 2048
@@ -254,6 +262,13 @@ class Llama(nn.Module):
     def has_aux_loss(self) -> bool:
         return self.num_experts > 0
 
+    @property
+    def flops_counter(self) -> str | None:
+        """Analytic-FLOPs family tag (tpudist.telemetry.flops) — the MFU
+        numerator dispatch. None for MoE geometries: the dense counter
+        would miscount routed experts."""
+        return None if self.num_experts > 0 else "llama"
+
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
                  decode: bool = False):
@@ -261,7 +276,7 @@ class Llama(nn.Module):
         if s > self.max_seq_len:
             raise ValueError(f"sequence {s} exceeds max_seq_len {self.max_seq_len}")
         kv = self.num_kv_heads or self.num_heads
-        ffn = self.ffn_dim or -(-8 * self.hidden_dim // 3 // 256) * 256
+        ffn = self.ffn_dim or default_ffn_dim(self.hidden_dim)
         embed = self.param(
             "embed",
             _partitioned(nn.initializers.normal(0.02), TENSOR_AXIS, None),

@@ -93,6 +93,14 @@ class ResNet(nn.Module):
     # the 128-lane systolic array tiles far better
     stem: str = "conv7"
 
+    @property
+    def flops_counter(self) -> str:
+        """Analytic-FLOPs family tag (tpudist.telemetry.flops) — the
+        counter itself returns None for geometries other than the
+        standard bottleneck ResNet-50, so every variant may carry the
+        tag safely."""
+        return "resnet"
+
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(

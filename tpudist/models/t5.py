@@ -226,6 +226,11 @@ class T5(nn.Module):
     max_decode_len: int = 128
     dtype: Any = jnp.float32
 
+    @property
+    def flops_counter(self) -> str:
+        """Analytic-FLOPs family tag (tpudist.telemetry.flops)."""
+        return "t5"
+
     @nn.compact
     def __call__(self, enc_tokens, dec_tokens=None, train: bool = True,
                  return_hidden: bool = False, encode_only: bool = False,

@@ -88,6 +88,13 @@ class ViT(nn.Module):
     dropout: float = 0.0  # residual dropout; rng plumbed by tpudist.train
     mesh: Any = None  # multi-chip Pallas attention (shard_map wrap)
 
+    @property
+    def flops_counter(self) -> str | None:
+        """Analytic-FLOPs family tag (tpudist.telemetry.flops). The vit
+        counter assumes the standard 4·H MLP; a custom mlp_dim gets no
+        tag (no MFU row) rather than a wrong numerator."""
+        return "vit" if self.mlp_dim == 4 * self.hidden_dim else None
+
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = jnp.asarray(x, self.dtype)

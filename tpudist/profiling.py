@@ -58,6 +58,7 @@ class WindowedProfiler:
         self._step = 0
         self._cycle = 0
         self._tracing = False
+        self._armed = 0  # remaining steps of an on-demand (arm()) window
 
     def __enter__(self):
         # wait+warmup == 0 means "capture from the first step" — the window
@@ -90,9 +91,34 @@ class WindowedProfiler:
             )
         return contextlib.nullcontext()
 
+    def arm(self, active_steps: int) -> bool:
+        """Open an on-demand capture window NOW for the next
+        ``active_steps`` iterations — the telemetry flight recorder's
+        anomaly capture (tpudist.telemetry), independent of the
+        wait/warmup/active schedule and usable even after every scheduled
+        ``repeat`` cycle has run. While a window (scheduled or armed) is
+        already recording, the anomaly is already in a trace: the call
+        extends nothing and reports True. Returns False when disabled —
+        the caller logs ``profiler_armed: false`` rather than losing the
+        anomaly event itself."""
+        if not self.enabled or active_steps <= 0:
+            return False
+        if self._tracing:
+            return True
+        self._armed = active_steps
+        self._start()
+        return True
+
     def step(self) -> None:
         """Advance the schedule; call once per training iteration
         (the ``p.step()`` of /root/reference/main.py:115)."""
+        if self._armed:
+            # an armed window counts its own steps and leaves the scheduled
+            # state machine (cycle/step counters) exactly where it froze
+            self._armed -= 1
+            if self._armed <= 0 and self._tracing:
+                self._close_armed()
+            return
         if not self.enabled or self._cycle >= self.repeat:
             return
         self._step += 1
@@ -102,6 +128,15 @@ class WindowedProfiler:
                 self._start()
         elif not self._tracing and self._step == self.skip:
             self._start()
+
+    def _close_armed(self) -> None:
+        # the armed-window teardown, shared by step()'s countdown and
+        # __exit__'s flush: closes the trace WITHOUT touching the scheduled
+        # cycle/step counters (contrast _stop)
+        self._armed = 0
+        jax.profiler.stop_trace()
+        self._tracing = False
+        logger.info("anomaly-armed trace written to %s", self.log_dir)
 
     def _stop(self) -> None:
         # block_until_ready is implicit: stop_trace flushes what the runtime
@@ -114,4 +149,9 @@ class WindowedProfiler:
 
     def __exit__(self, *exc):
         if self._tracing:
-            self._stop()
+            if self._armed:
+                # a run ending mid-anomaly-capture must not consume a
+                # scheduled repeat that never ran
+                self._close_armed()
+            else:
+                self._stop()

@@ -1,0 +1,460 @@
+"""Training-health telemetry: in-step metrics, NaN flight recorder,
+step-time breakdown, MFU accounting, and a structured JSONL sink.
+
+The reference's observability surface is a rank-0 TSV of loss and
+examples/sec plus one profiler window (``tpudist/metrics.py``,
+``tpudist/profiling.py`` — reproduced exactly and untouched). That answers
+"how fast"; this subsystem answers the three questions a production run
+dies without (docs/OBSERVABILITY.md):
+
+- **is training healthy?** — global grad-norm, param-norm, update-norm and
+  non-finite counts computed INSIDE the jit-compiled SPMD step
+  (``make_train_step(telemetry=True)``): a handful of reductions XLA fuses
+  into the existing gradient psum path, fetched through the same
+  one-step-delayed async pipeline as the loss — zero extra host syncs.
+  The bench leg ``telemetry_overhead_pct`` holds the cost under 2% of
+  step time.
+- **why did it die?** — :class:`NanSentry`, the flight recorder: the
+  in-graph guard (``make_train_step(guard_nonfinite=True)``) skips the
+  poisoned update the step it happens (params/opt-state/BN stats keep
+  their pre-step values, the step counter still advances so data position
+  stays exact); the host sentry then emits a structured ``anomaly`` event
+  and arms :class:`~tpudist.profiling.WindowedProfiler` for an on-demand
+  trace window around the anomaly. Rolling-window loss-spike detection
+  catches divergence that never reaches NaN.
+- **where does the time go?** — per-step data-wait / dispatch /
+  device-compute attribution in ``fit()`` plus per-process heartbeat rows,
+  so a slow input pipeline, a dispatch-bound host, and a multi-host
+  straggler all look different in the log. MFU rows combine the analytic
+  counters (:mod:`tpudist.telemetry.flops`) with measured step time.
+
+Everything lands in a per-process JSONL stream (:class:`TelemetrySink`)
+NEXT TO the reference TSV, which stays byte-identical when telemetry is
+off. Enable with ``fit(..., telemetry=True)`` or pass a
+:class:`TelemetryConfig` to tune knobs.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import math
+import numbers
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+from tpudist.telemetry import flops
+
+__all__ = [
+    "TelemetryConfig",
+    "TelemetrySink",
+    "NanSentry",
+    "TimedIterator",
+    "Telemetry",
+    "build_telemetry",
+    "flops",
+]
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Knobs for the telemetry subsystem; the defaults are what
+    ``fit(..., telemetry=True)`` runs.
+
+    ``health_metrics``/``guard_nonfinite`` steer the compiled step (norms
+    in-graph; skip poisoned updates). ``sentry`` drives the host-side
+    flight recorder: non-finite loss/grads fire an event; a loss
+    counts as a spike when it exceeds the rolling window's mean by
+    ``spike_sigma`` standard deviations (window of ``spike_window`` recent
+    finite losses, armed only after ``spike_min_steps`` observations);
+    ``cooldown_steps`` suppresses event storms after a detection.
+    ``capture_steps`` sizes the on-demand profiler window an anomaly arms.
+    ``peak_flops`` is PER-CHIP peak (``None`` → v5e bf16,
+    ``flops.DEFAULT_PEAK_FLOPS``). ``heartbeat_every`` is in steps
+    (``None`` → 10× the TSV log cadence; ``0`` → no heartbeat rows, the
+    same off-switch contract as ``fit``'s ``memory_log_every``).
+    ``jsonl_dir`` overrides where the sink writes (``None`` → fit's
+    ``log_dir``).
+    """
+
+    health_metrics: bool = True
+    guard_nonfinite: bool = True
+    sentry: bool = True
+    spike_window: int = 32
+    spike_sigma: float = 8.0
+    spike_min_steps: int = 16
+    cooldown_steps: int = 16
+    capture_on_anomaly: bool = True
+    capture_steps: int = 6
+    breakdown: bool = True
+    mfu: bool = True
+    peak_flops: float | None = None
+    heartbeat_every: int | None = None
+    jsonl_dir: str | None = None
+
+    def step_kwargs(self) -> dict:
+        """The ``make_train_step`` knobs this config implies — the ONE
+        mapping from config fields to compiled-step behavior (``fit()``
+        passes these through verbatim)."""
+        return {
+            "telemetry": self.health_metrics,
+            "guard_nonfinite": self.guard_nonfinite,
+        }
+
+
+def _json_safe(v):
+    """JSONL rows must stay strict-JSON parseable: non-finite floats become
+    null (a ``NaN`` literal breaks downstream ``json.loads``), numpy
+    scalars become python numbers."""
+    if isinstance(v, bool) or v is None or isinstance(v, (str, int)):
+        return v
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if not math.isfinite(f):
+        return None
+    # numpy integer scalars are not python ints (the early return above)
+    # but ARE Integral — keep counts like nonfinite_grad_count integers in
+    # the JSONL, not 5.0
+    return int(f) if isinstance(v, numbers.Integral) else f
+
+
+class TelemetrySink:
+    """Append-only structured JSONL writer — one file per process
+    (``{job_id}_telemetry_{rank}.jsonl``), one object per line:
+    ``{"v": 1, "t": <unix seconds>, "kind": ..., "rank": ..., "step": ...,
+    <kind-specific fields>}``. Kinds written by ``fit()``: ``health``,
+    ``step_breakdown``, ``mfu``, ``throughput``, ``memory``, ``anomaly``,
+    ``heartbeat``, ``train_time``, ``run_meta``. Schema glossary in
+    docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
+    APPEND mode — both halves of the flight-recorder contract: the anomaly
+    row must survive the crash it describes, including a checkpoint-resume
+    of the same job_id truncating the evidence before anyone read it.
+    Attempts are separable by the ``t`` timestamps."""
+
+    def __init__(self, path: str | Path, *, rank: int = 0, clock=time.time):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.rank = rank
+        self._clock = clock
+        self._file = open(self.path, "a")
+
+    def write(self, kind: str, step: int | None = None, **fields) -> dict:
+        row: dict[str, Any] = {
+            "v": SCHEMA_VERSION,
+            "t": round(float(self._clock()), 6),
+            "kind": kind,
+            "rank": self.rank,
+        }
+        if step is not None:
+            row["step"] = int(step)
+        row.update({k: _json_safe(v) for k, v in fields.items()})
+        self._file.write(json.dumps(row) + "\n")
+        self._file.flush()
+        return row
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NanSentry:
+    """Host-side anomaly detector over the per-step loss stream.
+
+    :meth:`observe` returns an event dict (``event``: ``"nonfinite"`` or
+    ``"loss_spike"``) or ``None``. Non-finite loss, a non-zero in-step
+    non-finite-gradient count, or an in-graph guard skip
+    (``update_skipped``) fires ``nonfinite``. Spikes fire when a
+    finite loss exceeds the rolling window's ``mean + sigma·std`` (and the
+    window has seen ``min_steps`` losses) — the "diverging but not yet
+    NaN" signal. Anomalous losses are NOT pushed into the window (one
+    spike must not drag the baseline up), and ``cooldown`` steps of
+    silence follow each event — for BOTH kinds — so a NaN'd-out or
+    diverging run emits a handful of rows, not one per step (the in-graph
+    skip counter still sees every poisoned step).
+    """
+
+    def __init__(self, *, window: int = 32, sigma: float = 8.0,
+                 min_steps: int = 16, cooldown: int = 16):
+        self.sigma = sigma
+        self.min_steps = max(min_steps, 2)
+        self.cooldown = cooldown
+        self._window: collections.deque[float] = collections.deque(maxlen=window)
+        self._quiet_until = -1
+        self.events: list[dict] = []
+
+    def observe(self, step: int, loss: float, *, nonfinite_count: int = 0,
+                update_skipped: int = 0) -> dict | None:
+        event = None
+        if (not math.isfinite(loss) or nonfinite_count > 0
+                or update_skipped > 0):
+            # update_skipped is its own trigger: with health_metrics=False
+            # the compiled step reports no nonfinite_grad_count, and a
+            # bf16 backward can overflow gradients under a finite loss —
+            # the in-graph guard's skip is then the only signal
+            event = {
+                "event": "nonfinite",
+                "loss": loss,
+                "nonfinite_grad_count": int(nonfinite_count),
+                "update_skipped": int(update_skipped),
+            }
+        elif len(self._window) >= self.min_steps:
+            mean = sum(self._window) / len(self._window)
+            var = sum((x - mean) ** 2 for x in self._window) / len(self._window)
+            std = math.sqrt(var)
+            # floor the spread: a zero-variance plateau (converged run,
+            # bf16-quantized loss) must not turn one-ulp jitter into a
+            # recurring spike event — anything within 1e-6 relative of the
+            # mean is noise, not divergence
+            spread = max(std, 1e-6 * abs(mean), 1e-12)
+            threshold = mean + self.sigma * spread
+            if loss > threshold:
+                event = {
+                    "event": "loss_spike",
+                    "loss": loss,
+                    "window_mean": mean,
+                    "window_std": std,
+                    "threshold": threshold,
+                    "update_skipped": int(update_skipped),
+                }
+        if event is not None:
+            # anomalous either way — the loss must stay OUT of the baseline
+            # window even when cooldown suppresses the event row, or a
+            # still-elevated post-spike run drags the mean up and silences
+            # every later detection
+            if step < self._quiet_until:
+                return None  # cooldown: a NaN'd-out/diverging run emits a
+                # handful of rows, not one per step — the skipped-update
+                # counter still accumulates in-graph, so nothing is lost,
+                # only deduplicated
+            event["step"] = int(step)
+            self._quiet_until = step + self.cooldown
+            self.events.append(event)
+            return event
+        if math.isfinite(loss):
+            self._window.append(loss)
+        return None
+
+
+class TimedIterator:
+    """Wrap a batch iterator and record the wall seconds the consumer spent
+    blocked in ``next()`` — fit()'s data-wait attribution. With the
+    prefetch queue healthy this is ~0; when it grows toward the step time
+    the run is input-bound (docs/PERF.md §3's diagnosis, now visible
+    per-step instead of requiring a bench A/B)."""
+
+    def __init__(self, iterator):
+        self._it = iter(iterator)
+        self.last_wait_s = 0.0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        try:
+            return next(self._it)
+        finally:
+            self.last_wait_s = time.perf_counter() - t0
+
+
+class Telemetry:
+    """The host half of the subsystem — owns the sink and sentry, driven by
+    ``fit()`` once per resolved step (one step after dispatch, on the same
+    delayed pipeline as the TSV rows). Scalar rows (``health``,
+    ``step_breakdown``, ``mfu``) are written by rank 0 at the TSV's
+    ``log_every`` cadence; ``heartbeat`` rows are written by EVERY process
+    (that is their point: a straggler host is visible by comparing its
+    heartbeat wall-clock drift against its peers'); ``anomaly`` rows are
+    written by whichever rank observed the anomaly, every time."""
+
+    def __init__(self, config: TelemetryConfig, sink: TelemetrySink, *,
+                 model=None, input_key: str = "tokens", profiler=None,
+                 rank: int = 0, world_size: int = 1, log_every: int = 5,
+                 n_chips: int = 1):
+        self.config = config
+        self.sink = sink
+        self.profiler = profiler
+        self.rank = rank
+        self.world_size = world_size
+        self.log_every = max(int(log_every), 1)
+        self.n_chips = max(int(n_chips), 1)
+        self.peak_flops = config.peak_flops or flops.DEFAULT_PEAK_FLOPS
+        # None → auto (10x the TSV cadence); 0 → off — the same contract
+        # as fit()'s memory_log_every, so `or` (which eats the 0) won't do
+        self.heartbeat_every = (
+            config.heartbeat_every if config.heartbeat_every is not None
+            else self.log_every * 10
+        )
+        self.sentry = (
+            NanSentry(
+                window=config.spike_window, sigma=config.spike_sigma,
+                min_steps=config.spike_min_steps,
+                cooldown=config.cooldown_steps,
+            )
+            if config.sentry else None
+        )
+        self._model = model
+        self._input_key = input_key
+        self._flops_per_step: float | None = None
+        self._tokens_per_step: int | None = None
+        self._sized = False
+
+    # -- wiring ------------------------------------------------------------
+
+    def observe_batch(self, batch: Mapping[str, Any]) -> None:
+        """Size the MFU numerator from the first staged batch's GLOBAL
+        shapes (once; analytic counters, no device work)."""
+        if self._sized or not self.config.mfu:
+            return
+        self._sized = True
+        self._flops_per_step = flops.train_step_flops(
+            self._model, batch, input_key=self._input_key
+        )
+        self._tokens_per_step = flops.tokens_per_step(
+            self._model, batch, input_key=self._input_key
+        )
+        if self.rank == 0:
+            self.sink.write(
+                "run_meta",
+                flops_per_step=self._flops_per_step,
+                tokens_per_step=self._tokens_per_step,
+                peak_flops_per_chip=self.peak_flops,
+                n_chips=self.n_chips,
+                world_size=self.world_size,
+                flops_counter=getattr(self._model, "flops_counter", None),
+            )
+
+    # -- per-step drive ----------------------------------------------------
+
+    def on_step(self, step: int, metrics: Mapping[str, float], *, epoch: int,
+                interval_s: float, data_wait_s: float | None = None,
+                dispatch_s: float | None = None,
+                device_s: float | None = None) -> dict | None:
+        """Record one RESOLVED step (host-side scalar values). Returns the
+        anomaly event if the sentry fired, else None."""
+        loss = float(metrics.get("loss", float("nan")))
+        nonfinite = int(metrics.get("nonfinite_grad_count", 0) or 0)
+        skipped = int(metrics.get("update_skipped", 0) or 0)
+        cadence = step % self.log_every == 0
+
+        if self.rank == 0 and cadence:
+            health = {
+                k: metrics[k]
+                for k in ("grad_norm", "param_norm", "update_norm",
+                          "nonfinite_grad_count", "update_skipped")
+                if k in metrics
+            }
+            if health:
+                self.sink.write("health", step, loss=loss, **health)
+            if self.config.breakdown and dispatch_s is not None:
+                self.sink.write(
+                    "step_breakdown", step,
+                    interval_s=round(interval_s, 6),
+                    data_wait_s=round(data_wait_s or 0.0, 6),
+                    dispatch_s=round(dispatch_s, 6),
+                    # device_s is measured on cadence steps only (a
+                    # block_until_ready there would stall the pipeline
+                    # every step); null on the rest
+                    device_s=None if device_s is None else round(device_s, 6),
+                )
+            if self._flops_per_step is not None and interval_s > 0:
+                self.sink.write(
+                    "mfu", step,
+                    # 8 decimals: a tiny CPU-test model's true MFU is ~1e-8
+                    # and must not round to a fake 0.0
+                    mfu=round(flops.mfu(
+                        self._flops_per_step, interval_s,
+                        peak=self.peak_flops, n_chips=self.n_chips,
+                    ), 8),
+                    flops_per_step=self._flops_per_step,
+                    step_time_s=round(interval_s, 6),
+                    tokens_per_sec=(
+                        None if self._tokens_per_step is None
+                        else round(self._tokens_per_step / interval_s, 2)
+                    ),
+                )
+
+        event = None
+        if self.sentry is not None:
+            event = self.sentry.observe(
+                step, loss, nonfinite_count=nonfinite, update_skipped=skipped
+            )
+            if event is not None:
+                armed = False
+                if self.config.capture_on_anomaly and self.profiler is not None:
+                    armed = bool(self.profiler.arm(self.config.capture_steps))
+                self.sink.write(
+                    "anomaly", step, epoch=epoch, profiler_armed=armed,
+                    **{k: v for k, v in event.items() if k != "step"},
+                )
+
+        if self.heartbeat_every and step % self.heartbeat_every == 0:
+            # every process writes its own heartbeat — the cross-host
+            # straggler signal
+            self.sink.write("heartbeat", step, epoch=epoch,
+                            interval_s=round(interval_s, 6))
+        return event
+
+    def finish(self, opt_state=None) -> None:
+        """Final summary row (rank 0): sentry event count and — when the
+        optimizer chain carries an ``amp.skip_nonfinite`` wrapper — its
+        skip counter (one host fetch, at run end only)."""
+        if self.rank != 0:
+            return
+        skips = None
+        if opt_state is not None:
+            from tpudist.amp import maybe_skipped_steps
+
+            skips = maybe_skipped_steps(opt_state)
+        self.sink.write(
+            "run_summary",
+            anomaly_events=len(self.sentry.events) if self.sentry else 0,
+            optimizer_nonfinite_skips=skips,
+        )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.sink.close()
+
+
+def build_telemetry(
+    telemetry: bool | TelemetryConfig,
+    *,
+    job_id: str,
+    log_dir: str,
+    rank: int,
+    world_size: int,
+    log_every: int,
+    n_chips: int,
+    profiler=None,
+    model=None,
+    input_key: str = "tokens",
+) -> Telemetry | None:
+    """fit()'s constructor: ``False`` → None (telemetry entirely off, the
+    reference TSV contract byte-identical), ``True`` → defaults, a
+    :class:`TelemetryConfig` → as configured."""
+    if not telemetry:
+        return None
+    config = telemetry if isinstance(telemetry, TelemetryConfig) else TelemetryConfig()
+    sink = TelemetrySink(
+        Path(config.jsonl_dir or log_dir) / f"{job_id}_telemetry_{rank}.jsonl",
+        rank=rank,
+    )
+    return Telemetry(
+        config, sink, model=model, input_key=input_key, profiler=profiler,
+        rank=rank, world_size=world_size, log_every=log_every, n_chips=n_chips,
+    )

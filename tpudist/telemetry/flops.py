@@ -1,0 +1,245 @@
+"""Analytic per-model training-step FLOPs counters — the MFU numerators.
+
+MFU (model FLOPs utilization) is the headline efficiency metric of
+"Scalable Training of Language Models using JAX pjit and TPUv4"
+(arXiv:2204.06514): analytic model FLOPs per step divided by step time and
+chip peak. This module is the ONE home for the analytic counters that were
+previously duplicated across ``bench.py``'s per-leg hand math and
+``examples/mfu_probe.py``'s GEMM tables — both now import from here, and
+``fit()``'s telemetry MFU rows use the same numbers, so a bench leg, the
+probe, and a live training run can never disagree about the numerator.
+
+Accounting convention (docs/PERF.md §4, kept bit-identical to the bench
+legs it replaced): weight GEMMs count forward + dgrad + wgrad
+(``6 · tokens · matmul_params``); attention counts 6 matmuls per layer
+(QKᵀ and AV, forward + two backward passes: ``12 · tokens · seq · hidden``
+with the causal factor folded into the convention, not halved); embedding
+lookups, norms, and elementwise work are excluded (sub-1% at these
+shapes). These are MODEL FLOPs — recompute from remat does NOT count,
+which is what makes the metric comparable across memory policies.
+
+Dispatch is duck-typed: a model advertises its counter family via a
+``flops_counter`` property (``"gpt2"``/``"llama"``/``"t5"``/``"bert"``/
+``"vit"``/``"resnet"``); :func:`train_step_flops` reads the model's own
+geometry fields and the batch's shapes. Models without the attribute (or
+geometries without a counter, e.g. a non-50-layer ResNet) return ``None``
+— no MFU row is ever fabricated from a guessed numerator.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping
+
+# TPU v5e bf16 peak — the single source of truth for the MFU denominator
+# (bench.py's V5E_BF16_PEAK and examples/mfu_probe.py's --peak default both
+# alias this). Override per-chip via TelemetryConfig.peak_flops / --peak.
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+def mfu(flops_per_step: float, step_seconds: float, *,
+        peak: float = DEFAULT_PEAK_FLOPS, n_chips: int = 1) -> float:
+    """Fraction of aggregate peak the step achieved; 0.0 on a degenerate
+    (non-positive) step time rather than a ZeroDivisionError — the same
+    coarse-clock guard as ``MetricsLogger.log_step``."""
+    if step_seconds <= 0.0:
+        return 0.0
+    return flops_per_step / step_seconds / (peak * max(n_chips, 1))
+
+
+# -- decoder / encoder LM counters (per GLOBAL step: pass global tokens) ----
+
+
+def gpt2_train_flops(tokens: float, *, hidden: int, depth: int, vocab: int,
+                     seq: int) -> float:
+    """GPT-2 geometry: 12·H² weight-GEMM params per block (qkv 3H² + out H²
+    + mlp 4H²+4H²), weight-tied head V·H."""
+    weight_matmul_params = depth * 12 * hidden * hidden + vocab * hidden
+    return 6.0 * tokens * weight_matmul_params + depth * 12.0 * tokens * seq * hidden
+
+
+def llama_train_flops(tokens: float, *, hidden: int, depth: int, ffn_dim: int,
+                      vocab: int, seq: int, num_heads: int,
+                      num_kv_heads: int) -> float:
+    """Llama geometry: GQA qkv (2H² q+o, 2·H·kv_heads·dh k+v), SwiGLU MLP
+    (3·H·ffn), un-tied head V·H."""
+    dh = hidden // num_heads
+    layer_p = (2 * hidden * hidden + 2 * hidden * (num_kv_heads * dh)
+               + 3 * hidden * ffn_dim)
+    return (6.0 * tokens * (depth * layer_p + vocab * hidden)
+            + depth * 12.0 * tokens * seq * hidden)
+
+
+def bert_train_flops(tokens: float, *, hidden: int, depth: int, vocab: int,
+                     seq: int) -> float:
+    """BERT MLM: 12·H² encoder blocks + the MLM head's H² transform and
+    tied V·H projection."""
+    return (6.0 * tokens * (depth * 12 * hidden * hidden + hidden * hidden
+                            + vocab * hidden)
+            + depth * 12.0 * tokens * seq * hidden)
+
+
+def vit_train_flops(tokens: float, *, hidden: int, depth: int,
+                    seq: int) -> float:
+    """ViT encoder blocks only (12·H² per block); the patch embed and
+    classifier head are sub-1% at ImageNet shapes and excluded."""
+    return (6.0 * tokens * depth * 12 * hidden * hidden
+            + depth * 12.0 * tokens * seq * hidden)
+
+
+def t5_train_flops(enc_tokens: float, dec_tokens: float, *, hidden: int,
+                   ffn_dim: int, enc_depth: int, dec_depth: int, vocab: int,
+                   enc_len: int, dec_len: int) -> float:
+    """T5 v1.1 geometry: self-attn 4H² + gated-GELU MLP 3·H·ffn per block,
+    decoder cross-attn q/o on dec tokens and k/v on enc tokens, un-tied
+    head. Bit-identical to the bench_t5 hand model it replaced."""
+    h, ffn = hidden, ffn_dim
+    te, td = enc_tokens, dec_tokens
+    attn_p, mlp_p = 4 * h * h, 3 * h * ffn
+    gemm = 3.0 * 2.0 * (
+        te * enc_depth * (attn_p + mlp_p)
+        + td * dec_depth * (attn_p + mlp_p)
+        + dec_depth * (2 * h * h * td + 2 * h * h * te)
+        + td * vocab * h
+    )
+    attn = 6.0 * 2.0 * (
+        te * enc_len * h * enc_depth
+        + td * dec_len * h * dec_depth
+        + td * enc_len * h * dec_depth
+    )
+    return gemm + attn
+
+
+# ResNet-50 at 224×224: ~4.1 GFLOPs forward per image (the standard
+# multiply+add count); backward ≈ 2× forward, same as the transformer
+# convention above. Other ResNet geometries return None (no counter) —
+# a guessed constant is worse than an absent row.
+RESNET50_FWD_FLOPS_224 = 4.1e9
+_RESNET50_STAGES = (3, 4, 6, 3)
+
+
+def resnet_train_flops(images: float, *, stage_sizes, image_size: int = 224,
+                       bottleneck: bool = True) -> float | None:
+    if not bottleneck or tuple(stage_sizes) != _RESNET50_STAGES:
+        return None
+    scale = (image_size / 224.0) ** 2
+    return 3.0 * RESNET50_FWD_FLOPS_224 * scale * images
+
+
+# -- the dispatcher ----------------------------------------------------------
+
+
+def _rows(shape, trailing: int) -> int:
+    """Flat example count of a batch leaf: product of all dims before the
+    ``trailing`` content dims — handles both the loader's flat [B, ...] and
+    the grad-accum staged [accum, micro, ...] layouts."""
+    lead = shape[: len(shape) - trailing]
+    return int(math.prod(lead)) if lead else 1
+
+
+def train_step_flops(model: Any, batch: Mapping[str, Any], *,
+                     input_key: str = "tokens") -> float | None:
+    """Analytic model FLOPs of ONE training step of ``model`` on ``batch``
+    (shapes only — works on host arrays, staged ``jax.Array``s, or
+    ``jax.eval_shape`` results). Returns ``None`` when the model doesn't
+    advertise a counter (``flops_counter``), the batch is missing the
+    expected keys (e.g. an index-only DeviceCachedLoader batch), or the
+    geometry has no counter — callers must treat ``None`` as "no MFU row",
+    never as zero.
+    """
+    family = getattr(model, "flops_counter", None)
+    if family is None:
+        return None
+    try:
+        if family == "t5":
+            enc, dec = batch["enc_tokens"].shape, batch["dec_tokens"].shape
+            return t5_train_flops(
+                _rows(enc, 1) * enc[-1], _rows(dec, 1) * dec[-1],
+                hidden=model.hidden_dim, ffn_dim=model.ffn_dim,
+                enc_depth=model.enc_depth, dec_depth=model.dec_depth,
+                vocab=model.vocab_size, enc_len=enc[-1], dec_len=dec[-1],
+            )
+        shape = batch[input_key].shape
+    except (KeyError, AttributeError):
+        return None
+    if family == "gpt2":
+        seq = shape[-1]
+        return gpt2_train_flops(
+            _rows(shape, 1) * seq, hidden=model.hidden_dim,
+            depth=model.depth, vocab=model.vocab_size, seq=seq,
+        )
+    if family == "llama":
+        seq = shape[-1]
+        from tpudist.models.llama import default_ffn_dim
+
+        ffn = model.ffn_dim or default_ffn_dim(model.hidden_dim)
+        return llama_train_flops(
+            _rows(shape, 1) * seq, hidden=model.hidden_dim,
+            depth=model.depth, ffn_dim=ffn, vocab=model.vocab_size, seq=seq,
+            num_heads=model.num_heads,
+            num_kv_heads=model.num_kv_heads or model.num_heads,
+        )
+    if family == "bert":
+        seq = shape[-1]
+        return bert_train_flops(
+            _rows(shape, 1) * seq, hidden=model.hidden_dim,
+            depth=model.depth, vocab=model.vocab_size, seq=seq,
+        )
+    if family == "vit":
+        patches = (shape[-3] // model.patch_size) * (shape[-2] // model.patch_size)
+        seq = patches + 1  # the CLS token
+        return vit_train_flops(
+            _rows(shape, 3) * seq, hidden=model.hidden_dim,
+            depth=model.depth, seq=seq,
+        )
+    if family == "resnet":
+        block_cls = getattr(model, "block_cls", None)
+        return resnet_train_flops(
+            _rows(shape, 3), stage_sizes=model.stage_sizes,
+            image_size=shape[-3],
+            bottleneck=getattr(block_cls, "__name__", "") == "BottleneckBlock",
+        )
+    return None
+
+
+def tokens_per_step(model: Any, batch: Mapping[str, Any], *,
+                    input_key: str = "tokens") -> int | None:
+    """The throughput denominator matching :func:`train_step_flops`'s
+    numerator: total tokens (LMs; enc+dec for T5) or images (vision) per
+    step, or ``None`` for the same cases the counter returns ``None``."""
+    family = getattr(model, "flops_counter", None)
+    if family is None:
+        return None
+    try:
+        if family == "t5":
+            enc, dec = batch["enc_tokens"].shape, batch["dec_tokens"].shape
+            return _rows(enc, 1) * enc[-1] + _rows(dec, 1) * dec[-1]
+        shape = batch[input_key].shape
+    except (KeyError, AttributeError):
+        return None
+    if family in ("gpt2", "llama", "bert"):
+        return _rows(shape, 1) * shape[-1]
+    if family in ("vit", "resnet"):
+        return _rows(shape, 3)
+    return None
+
+
+def gpt2_step_shapes(tokens: int, hidden: int, vocab: int = 50257,
+                     ce_chunk_rows: int = 4096) -> list[tuple[str, int, int, int]]:
+    """The GEMM shapes of one GPT-2 block + tied head, forward and the two
+    backward passes (dgrad/wgrad) per GEMM, at ``tokens`` rows — the
+    per-GEMM table behind ``examples/mfu_probe.py`` (docs/PERF.md §4b)."""
+    t, d = tokens, hidden
+    fwd = [
+        ("qkv", t, d, 3 * d),
+        ("attn_out", t, d, d),
+        ("mlp_fc", t, d, 4 * d),
+        ("mlp_proj", t, 4 * d, d),
+        ("lm_head(chunk)", ce_chunk_rows, d, vocab),
+    ]
+    shapes = []
+    for name, m, k, n in fwd:
+        shapes.append((f"{name} fwd", m, k, n))
+        shapes.append((f"{name} dgrad", m, n, k))
+        shapes.append((f"{name} wgrad", k, m, n))
+    return shapes

@@ -198,8 +198,25 @@ def make_train_step(
     forward_loss: Callable | None = None,
     dropout_seed: int = 0,
     input_transform: Callable | None = None,
+    telemetry: bool = False,
+    guard_nonfinite: bool = False,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
+
+    ``telemetry=True`` folds the in-step health metrics into the compiled
+    program (tpudist.telemetry): global grad-norm, param-norm (pre-update),
+    update-norm, and the non-finite gradient element count ride the metrics
+    pytree out — a handful of reductions XLA fuses into the existing
+    backward/psum path, measured <2% of step time by the bench's
+    ``telemetry_overhead_pct`` leg. ``guard_nonfinite=True`` additionally
+    SKIPS a poisoned update inside the same program: when the loss or any
+    gradient is non-finite, params/opt-state/batch-stats keep their
+    pre-step values (the step counter still advances, so data position and
+    resume math stay exact) and ``metrics["update_skipped"]`` reports 1.
+    The in-graph skip is what makes the host-side NaN sentry's event
+    "after the fact" harmless — by the time the host sees the anomaly the
+    state was never corrupted. Both default off: the step's programs (and
+    HLO) are bit-identical to previous rounds when unused.
 
     ``input_transform``: optional in-graph function applied to
     ``batch[input_key]`` before the model — e.g.
@@ -331,15 +348,60 @@ def make_train_step(
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        # loss is the global-batch mean — the in-graph equivalent of the
+        # reference's post-step reduce_loss (main.py:105)
+        metrics = {"loss": loss}
+        if telemetry:
+            # health metrics inside the same compiled program: these are
+            # full-tree reductions over values the step already holds, so
+            # XLA schedules them alongside the backward pass and the only
+            # addition to the metrics fetch is four more scalars on the
+            # existing one-step-delayed async path
+            nonfinite = jnp.asarray(sum(
+                jnp.sum(~jnp.isfinite(g))
+                for g in jax.tree_util.tree_leaves(grads)
+            ), jnp.int32)
+            metrics.update(
+                grad_norm=optax.global_norm(grads),
+                param_norm=optax.global_norm(state.params),
+                update_norm=optax.global_norm(updates),
+                nonfinite_grad_count=nonfinite,
+            )
+        if guard_nonfinite:
+            if telemetry:
+                ok = jnp.isfinite(loss) & (metrics["nonfinite_grad_count"] == 0)
+            else:
+                from tpudist.amp import all_finite
+
+                ok = jnp.isfinite(loss) & all_finite(grads)
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, old
+            )
+            from tpudist.amp import is_skip_state
+
+            new_params = keep(new_params, state.params)
+            new_opt = keep(new_opt, state.opt_state)
+            if is_skip_state(new_opt):
+                # amp.skip_nonfinite's (inner_state, int32 counter) shape,
+                # static at trace time: the counter is run metadata (how
+                # many updates were rejected), not optimizer state — the
+                # freeze must not revert its increment, or
+                # amp.skipped_steps / the telemetry run-summary read 0
+                # whenever the guard is on. Under the guard "rejected"
+                # means exactly ~ok, whichever check (the wrapper's own
+                # updates scan or the guard's loss/grad one) caught it.
+                new_opt = (new_opt[0], jnp.where(
+                    ok, new_opt[1], state.opt_state[1] + 1
+                ))
+            new_stats = keep(new_stats, state.batch_stats)
+            metrics["update_skipped"] = (~ok).astype(jnp.int32)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt,
         )
-        # loss is the global-batch mean — the in-graph equivalent of the
-        # reference's post-step reduce_loss (main.py:105)
-        return new_state, {"loss": loss}
+        return new_state, metrics
 
     repl = mesh_lib.replicated_sharding(mesh)
     out_state_sharding = state_sharding if state_sharding is not None else repl
@@ -406,6 +468,8 @@ def fit(
     profile: bool = True,
     prefetch_depth: int = 2,
     log_dir: str = ".",
+    telemetry: bool | Any = False,
+    memory_log_every: int | None = None,
     metrics_logger: MetricsLogger | None = None,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
@@ -424,6 +488,21 @@ def fit(
     step it stopped at (same epoch, same position in the sampler's
     deterministic order) — a capability the reference lacks entirely
     (SURVEY.md §5: no save/load; crash = start over).
+
+    ``telemetry`` (False | True | ``tpudist.telemetry.TelemetryConfig``)
+    turns on the observability subsystem (docs/OBSERVABILITY.md): in-step
+    health metrics and the non-finite update guard inside the compiled
+    step, the NaN/divergence sentry + on-demand profiler flight recorder,
+    per-step data-wait/dispatch/device time attribution, MFU rows for
+    models that advertise a ``flops_counter``, and per-process heartbeat
+    rows — all into a ``{job_id}_telemetry_{rank}.jsonl`` stream next to
+    the TSV, which stays byte-identical to the reference contract when
+    telemetry is off.
+
+    ``memory_log_every`` cadences ``MetricsLogger.log_memory`` (live HBM
+    rows) during training: ``None`` (default) auto-selects ``log_every·10``
+    steps on backends that report allocator stats and off on those that
+    don't (CPU); ``0`` disables; ``N`` forces a cadence.
 
     ``shard_opt_state=True`` wraps ``tx`` in ZeRO-1 cross-replica
     optimizer-state sharding (``tpudist.optim.shard_state``): the Adam
@@ -491,12 +570,21 @@ def fit(
     from tpudist.distributed import verify_replicas
 
     verify_replicas(state.params)
+    tel_cfg = None
+    if telemetry:
+        from tpudist.telemetry import TelemetryConfig
+
+        tel_cfg = (
+            telemetry if isinstance(telemetry, TelemetryConfig)
+            else TelemetryConfig()
+        )
     step = make_train_step(
         model, tx, mesh,
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
         forward_loss=forward_loss, dropout_seed=seed,
         input_transform=input_transform,
+        **(tel_cfg.step_kwargs() if tel_cfg else {}),
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
         # here would all-gather a TP model's params on the first step
@@ -528,6 +616,7 @@ def fit(
     start_step = 0
     losses: list[float] = []
     logger = None
+    tel = None
     try:
         if checkpoint_dir is not None:
             from tpudist.checkpoint import Checkpointer
@@ -571,34 +660,86 @@ def fit(
             job_id, enabled=profile, log_dir=f"{log_dir}/log_{job_id}"
         ) as p:
             print("Start")
+            from tpudist.telemetry import TimedIterator, build_telemetry
+
+            # sink attached BEFORE the first log_memory: the dual-sink
+            # contract mirrors every logger row, including the bring-up
+            # HBM baseline the live cadence rows are compared against
+            tel = build_telemetry(
+                tel_cfg or False,
+                job_id=job_id, log_dir=log_dir, rank=global_rank,
+                world_size=world_size, log_every=logger.log_every,
+                n_chips=jax.device_count(), profiler=p, model=model,
+                input_key=input_key,
+            )
+            if tel is not None:
+                logger.attach_sink(tel.sink)
+            breakdown = tel is not None and tel.config.breakdown
+
             # live HBM snapshot post-bring-up (params+opt state placed,
             # no activations yet): the measured side of the pre-compile
             # budget tpudist.memory reports; silent no-op on backends
             # without memory_stats (CPU)
             from tpudist.memory import device_memory_stats
 
-            logger.log_memory(device_memory_stats())
+            mem_stats = device_memory_stats()
+            logger.log_memory(mem_stats)
+            # automatic HBM-row cadence (None = auto: on only where the
+            # allocator reports stats — the probe above doubles as the
+            # capability check; 0 = off; N = every N steps)
+            mem_every = memory_log_every
+            if mem_every is None:
+                mem_every = logger.log_every * 10 if mem_stats else 0
+
             global_step = start_step
             logger.start_timer()
 
-            # one-step-delayed metric resolution: step k's scalar loss is
-            # FETCHED while step k+1 executes (copy_to_host_async starts the
-            # D2H as soon as the value exists). A synchronous per-step fetch
-            # would insert one host↔device round trip into every step — fine
-            # on a local PCIe attach (~0.1 ms), a throughput cliff on a
-            # remote/tunnel attach (~100 ms RTT measured). One step stays in
-            # flight, which also throttles dispatch to the device rate. Rows
-            # land in the TSV in step order, one iteration later; the logged
+            # one-step-delayed metric resolution: step k's scalars (loss +
+            # the in-step health metrics) are FETCHED while step k+1
+            # executes (copy_to_host_async starts the D2H as soon as the
+            # values exist). A synchronous per-step fetch would insert one
+            # host↔device round trip into every step — fine on a local PCIe
+            # attach (~0.1 ms), a throughput cliff on a remote/tunnel attach
+            # (~100 ms RTT measured). One step stays in flight, which also
+            # throttles dispatch to the device rate. Rows land in the TSV
+            # (and JSONL) in step order, one iteration later; the logged
             # duration is the inter-step interval (the sustained rate the
             # reference's clock measures, /root/reference/main.py:95-111).
-            pending = None  # (global_step, epoch, batch_idx, start_time, loss)
+            pending = None  # (step, epoch, idx, start, metrics, breakdown)
+            # device-time probe staging (see the barrier below): the probe
+            # runs 2 steps before each logged row so neither the logged
+            # interval (barrier stall inflates it) nor the one right before
+            # it (the post-barrier bubble deflates it — the resolve-side
+            # backpressure needs one step to re-establish) is perturbed.
+            # Cadences too short to stagger keep the probe on the logged
+            # step itself.
+            probe_offset = (
+                2 if breakdown and tel.log_every >= 3 else 0
+            )
+            device_probe = None
 
             def resolve(now):
-                g, pe, pidx, pstart, dev_loss = pending
-                loss_value = float(dev_loss)
+                g, pe, pidx, pstart, dev_metrics, waits = pending
+                # integer metrics (nonfinite_grad_count, update_skipped)
+                # stay ints — float() here would defeat the sink's
+                # Integral-preserving serialization and land 3.0 in rows
+                # documented as integer counts
+                host = {
+                    k: (int(v) if jnp.issubdtype(v.dtype, jnp.integer)
+                        else float(v))
+                    for k, v in dev_metrics.items()
+                }
+                loss_value = host["loss"]
                 losses.append(loss_value)
                 logger.log_step(g, loss_value, now - pstart)
                 logger.print_progress(pe, pidx, loss_value)
+                if tel is not None:
+                    data_wait_s, dispatch_s, device_s = waits
+                    tel.on_step(
+                        g, host, epoch=pe, interval_s=now - pstart,
+                        data_wait_s=data_wait_s, dispatch_s=dispatch_s,
+                        device_s=device_s,
+                    )
 
             try:
                 for e in range(start_epoch, epochs):
@@ -616,23 +757,65 @@ def fit(
                         batches = itertools.islice(iter(train_loader), first_idx, None)
                     else:
                         batches = iter(train_loader)
-                    for idx, batch in enumerate(
-                        prefetch_to_mesh(
-                            batches, mesh,
-                            depth=prefetch_depth, stage_fn=step.stage,
-                        ),
-                        start=first_idx,
-                    ):
+                    staged = prefetch_to_mesh(
+                        batches, mesh,
+                        depth=prefetch_depth, stage_fn=step.stage,
+                    )
+                    if breakdown:
+                        # data-wait attribution: seconds this loop blocked
+                        # on the prefetch queue (≈0 while the pipeline keeps
+                        # up; → step time when the run is input-bound)
+                        staged = TimedIterator(staged)
+                    for idx, batch in enumerate(staged, start=first_idx):
                         start = time.time()
                         global_step += 1
+                        if tel is not None:
+                            tel.observe_batch(batch)
+                        dispatch_t0 = time.perf_counter()
                         with p.annotate(global_step):
                             state, metrics = step(state, batch)
-                        loss_dev = metrics["loss"]
-                        loss_dev.copy_to_host_async()
+                        dispatch_s = time.perf_counter() - dispatch_t0
+                        for v in metrics.values():
+                            v.copy_to_host_async()
+                        device_s = None
+                        if breakdown:
+                            if (global_step + probe_offset) % tel.log_every == 0:
+                                # cadenced device-time attribution: block
+                                # until THIS step's result exists (includes
+                                # any queued predecessor — the pipeline is
+                                # 1 deep). Once per cadence, staggered off
+                                # the logged step (probe_offset above): a
+                                # per-step barrier would serialize the very
+                                # pipeline it measures, and a barrier inside
+                                # a logged step's interval would inflate
+                                # exactly the throughput/MFU rows that
+                                # advertise the sustained rate.
+                                jax.block_until_ready(metrics["loss"])
+                                device_probe = (
+                                    time.perf_counter() - dispatch_t0
+                                )
+                            if global_step % tel.log_every == 0:
+                                device_s = device_probe
+                        # profiler schedule advances BEFORE resolve: resolve
+                        # may arm the anomaly window, and arming after this
+                        # iteration's step() means the window's countdown
+                        # only starts at the NEXT annotated step — the full
+                        # capture_steps budget lands on annotated steps
+                        # (arming before it would burn one tick on the
+                        # already-dispatched current iteration)
+                        p.step()
                         if pending is not None:
                             resolve(start)
-                        pending = (global_step, e, idx, start, loss_dev)
-                        p.step()
+                        pending = (
+                            global_step, e, idx, start, metrics,
+                            (
+                                staged.last_wait_s if breakdown else None,
+                                dispatch_s,
+                                device_s,
+                            ),
+                        )
+                        if mem_every and global_step % mem_every == 0:
+                            logger.log_memory(device_memory_stats())
                         if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
                             ckpt.save(state)
             except BaseException:
@@ -651,9 +834,16 @@ def fit(
                 if pending is not None:
                     resolve(time.time())
                     pending = None
+                if tel is not None:
+                    tel.finish(state.opt_state)
             if ckpt and global_step > start_step:
                 ckpt.save(state)
     finally:
+        # closed here, OUTSIDE the logger's context: the logger's __exit__
+        # mirrors its TrainTime footer into the sink (dual-sink mode), so
+        # the sink must outlive it
+        if tel is not None:
+            tel.sink.close()
         if ckpt:
             ckpt.close()
     return state, losses
