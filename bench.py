@@ -73,6 +73,14 @@ Legs
    contract: the 124M step compiled bare vs with in-step health metrics +
    the non-finite update guard (interleaved A/B); must stay under 2%
    step-time overhead (docs/OBSERVABILITY.md).
+14. ``gpt2_124m_quantized_ar_tokens_per_sec_per_chip`` /
+   ``gpt2_124m_comm_bytes_per_step`` — the communication-efficiency legs
+   (docs/PERF.md §11): the same 124M step trained through the explicit
+   int8-quantized gradient all-reduce (``make_train_step(
+   reduce="quantized")`` — bucketed, stochastic rounding, error feedback,
+   double-buffered with the accumulation scan), and the wire-volume record
+   pinned to a v5e-8 world: int8 bytes/step vs the same-schedule fp32
+   bytes (vs_baseline = compression ratio / 3 — ≥1 meets the ≥3× bar).
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -1243,6 +1251,112 @@ def bench_telemetry_overhead() -> None:
     )
 
 
+def bench_comm_efficiency() -> None:
+    """The communication-efficiency legs (docs/PERF.md §11).
+
+    Leg A — ``gpt2_124m_quantized_ar_tokens_per_sec_per_chip``: leg 4's
+    exact GPT-2 124M config (seq 1024, 8×4-accum/chip, bf16, vmem
+    attention, chunk-512 CE) trained through the EXPLICIT int8-quantized
+    gradient all-reduce (``make_train_step(reduce="quantized")``): per-
+    replica grads inside a shard_map, fixed-size buckets, int8 wire with
+    per-bucket scales + stochastic rounding + error feedback, reduction
+    double-buffered with the accumulation scan. Same target as leg 4, so
+    the two rates are directly comparable — on a single-slice/ICI attach
+    the explicit path must hold leg 4's rate (the acceptance bar); the
+    bytes win only cashes out on a DCN-crossing attach. On a 1-chip attach
+    the reducer resolves to a no-op and the leg measures the plain step.
+
+    Leg B — ``gpt2_124m_comm_bytes_per_step``: the wire-volume record,
+    PINNED to a v5e-8 world (the memory leg's precedent: pure accounting,
+    exact from the bucket layout, comparable across rounds regardless of
+    the attach's chip count). value = int8 MB/step per replica at the
+    leg-A schedule (accum+1 reductions); vs_baseline = (same-schedule fp32
+    bytes / int8 bytes) / 3 — ≥ 1.0 meets the ≥3× compression bar. The
+    unit string carries the fp32 equivalent and the single-AR bytes XLA's
+    implicit path would move (the overlap trade's honest baseline).
+    """
+    from tpudist import mesh as mesh_lib
+    from tpudist.comm import BucketLayout
+    from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    seq_len, micro_per_chip, grad_accum = 1024, 8, 4
+    seqs_per_step = micro_per_chip * grad_accum * n_chips
+    tokens_per_step = seqs_per_step * seq_len
+
+    # NO mesh= on the model: inside the reducer's shard_map the batch is
+    # already local, so the attention kernel must not wrap its own
+    # shard_map (tpudist/parallel/dp.py's contract)
+    model = GPT2(dtype=jnp.bfloat16, attn_impl="vmem")
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+    )
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", grad_accum=grad_accum,
+        forward_loss=chunked_lm_forward(model, chunk=512),
+        reduce="quantized",
+    )
+    active = step.grad_reducer is not None
+    if active:
+        state = step.grad_reducer.attach_residual(state)
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    n_steps = 30
+    batches = iter([
+        rng.integers(0, 50257, (seqs_per_step, seq_len)).astype(np.int32)
+        for _ in range(n_steps + 3)
+    ])
+    for _ in range(3):
+        state, metrics = step(state, {"tokens": next(batches)})
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, {"tokens": next(batches)})
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    _emit(
+        "gpt2_124m_quantized_ar_tokens_per_sec_per_chip",
+        tokens_per_step * n_steps / dt / n_chips,
+        "tokens/sec/chip through the explicit int8-quantized gradient "
+        "all-reduce (bucketed, stochastic rounding, error feedback, "
+        "double-buffered with the 8x4 accumulation scan; bf16, seq 1024, "
+        "vocab 50257, chunked CE, vmem attention"
+        + (f", {step.grad_reducer.world}-replica ring)" if active
+           else "; 1-chip attach: reducer resolves to a no-op)"),
+        TARGET_TOK_PER_SEC_PER_CHIP,
+    )
+
+    # -- leg B: wire volume, pinned world-8 accounting ---------------------
+    layout = BucketLayout(state.params, world=8)
+    reductions = grad_accum + 1  # the double-buffered schedule's count
+    q = layout.wire_bytes("quantized", reductions=reductions)
+    f = layout.wire_bytes("bucketed", reductions=reductions)
+    implicit = layout.wire_bytes("bucketed", reductions=1)
+    _record_line(
+        {
+            "metric": "gpt2_124m_comm_bytes_per_step",
+            "value": round(q / 1e6, 2),
+            "unit": "MB/step/replica on the wire, int8-quantized AR at the "
+            "leg's schedule (8-replica ring, %d reductions/step incl. the "
+            "residual flush, %d buckets x %d elems + fp32 scales) — vs "
+            "%.1f MB fp32 at the SAME schedule (%.2fx compression) and "
+            "%.1f MB for the implicit single fp32 all-reduce; "
+            "vs_baseline = compression / 3 (>=1 meets the >=3x bar), "
+            "docs/PERF.md §11" % (
+                reductions, layout.n_buckets, layout.bucket_size,
+                f / 1e6, f / q, implicit / 1e6,
+            ),
+            "fp32_bytes_per_step": f,
+            "implicit_fp32_bytes_per_step": implicit,
+            "vs_baseline": round(f / q / 3.0, 4),
+        }
+    )
+
+
 # leg groups: (function, wall-clock budget in seconds). Budgets are ~3x the
 # healthy-attach duration of each group, so they only fire on a wedge.
 _LEG_GROUPS = {
@@ -1259,6 +1373,9 @@ _LEG_GROUPS = {
     "memory": (bench_memory_discipline, 1500),
     # two compiles of the 124M step + 2x4x8 measured steps
     "telemetry": (bench_telemetry_overhead, 1800),
+    # one compile of the quantized-AR step + 30 measured steps; the byte
+    # record is pure accounting
+    "comm": (bench_comm_efficiency, 1800),
 }
 
 

@@ -109,6 +109,15 @@ def parse_args(argv=None):
                         help="smoothed-CE epsilon in [0,1) (ImageNet recipe: "
                         "0.1); 0 = the reference's plain CE (main.py:79)")
     parser.add_argument("--grad_accum", default=1, type=int)
+    parser.add_argument("--reduce", default="none",
+                        choices=("none", "bucketed", "quantized", "auto"),
+                        help="gradient-reduction path (tpudist.parallel.dp)"
+                        ": none = implicit XLA psum (optimal on ICI); "
+                        "bucketed = explicit fp32 bucketed all-reduce; "
+                        "quantized = int8-on-the-wire with per-bucket "
+                        "scales + error feedback (the DCN-bound lever, "
+                        "docs/PERF.md §11); auto = quantized on a "
+                        "multi-slice attach, none otherwise")
     parser.add_argument("--augment", action="store_true",
                         help="train augmentation (crop+flip+normalize); "
                         "reference default is ToTensor only. Host-side for "
@@ -358,6 +367,7 @@ def main(argv=None):
         world_size=ctx.world_size,
         global_rank=ctx.process_index,
         grad_accum=args.grad_accum,
+        reduce=args.reduce,
         input_transform=input_transform,
         profile=not args.no_profiler,
         log_dir=args.log_dir,

@@ -536,3 +536,107 @@ def test_maybe_skipped_steps_reads_amp_wrapper():
     assert maybe_skipped_steps(s) == 1
     # a bare optax chain has no counter: None, not a fabricated 0
     assert maybe_skipped_steps(optax.adam(1e-3).init(params)) is None
+
+
+# -- explicit-reduction comm accounting + link-bound diagnosis ---------------
+
+
+def _bare_tel(tmp_path, **cfg_kw):
+    return build_telemetry(
+        TelemetryConfig(mfu=False, sentry=False, **cfg_kw), job_id="J",
+        log_dir=str(tmp_path), rank=0, world_size=1, log_every=1, n_chips=1,
+    )
+
+
+def test_set_comm_writes_setup_row_and_breakdown_columns(tmp_path):
+    """set_comm: one self-describing `comm` row (method/bucket geometry,
+    fp32-equivalent bytes, measured probe), then every step_breakdown row
+    carries the live comm_bytes (from the step's metrics — the delayed
+    fetch) and the probe-derived comm_s column."""
+    tel = _bare_tel(tmp_path)
+    tel.set_comm(
+        {"method": "quantized", "world": 8, "bytes_per_step": 1000,
+         "fp32_bytes_per_step": 4000}, probe_s=0.0123,
+    )
+    tel.on_step(1, {"loss": 1.0, "comm_bytes": 1000.0}, epoch=0,
+                interval_s=0.1, dispatch_s=0.05)
+    tel.sink.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "J_telemetry_0.jsonl").read_text().splitlines()]
+    comm = [r for r in rows if r["kind"] == "comm"]
+    assert len(comm) == 1
+    assert comm[0]["method"] == "quantized"
+    assert comm[0]["fp32_bytes_per_step"] == 4000
+    assert comm[0]["probe_s"] == 0.0123
+    bd = [r for r in rows if r["kind"] == "step_breakdown"]
+    assert bd and bd[0]["comm_bytes"] == 1000.0
+    assert bd[0]["comm_s"] == 0.0123
+
+
+def test_breakdown_rows_unchanged_without_comm(tmp_path):
+    """Feature off ⇒ step_breakdown rows carry exactly the pre-existing
+    fields — no null comm columns leaking into old dashboards."""
+    tel = _bare_tel(tmp_path)
+    tel.on_step(1, {"loss": 1.0}, epoch=0, interval_s=0.1, dispatch_s=0.05)
+    tel.sink.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "J_telemetry_0.jsonl").read_text().splitlines()]
+    bd = [r for r in rows if r["kind"] == "step_breakdown"][0]
+    assert "comm_bytes" not in bd and "comm_s" not in bd
+    assert not any(r["kind"] == "comm" for r in rows)
+
+
+def test_link_bound_warning_fires_once_with_hint(tmp_path):
+    """The fit() H2D diagnosis: staging the observed batch at the probed
+    link rate would eat most of the step — ONE tagged warning row pointing
+    at DeviceCachedLoader, not a silent 0.08x run."""
+    tel = _bare_tel(tmp_path)
+    tel.h2d_mbps = 10.0  # a collapsed link (docs/PERF.md §3 measured 7)
+    tel.observe_batch({"image": np.zeros((256, 224, 224, 3), np.uint8)})
+    for s in range(1, 4):
+        tel.on_step(s, {"loss": 1.0}, epoch=0, interval_s=0.1,
+                    dispatch_s=0.05)
+    tel.sink.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "J_telemetry_0.jsonl").read_text().splitlines()]
+    warns = [r for r in rows if r["kind"] == "warning"]
+    assert len(warns) == 1  # one-shot, not a row per step
+    assert warns[0]["tag"] == "h2d_link_bound"
+    assert "DeviceCachedLoader" in warns[0]["hint"]
+    assert warns[0]["h2d_mbps"] == 10.0
+    assert warns[0]["est_staging_s"] > 0.5 * warns[0]["interval_s"]
+
+
+def test_link_bound_warning_quiet_on_healthy_link(tmp_path):
+    tel = _bare_tel(tmp_path)
+    tel.h2d_mbps = 10_000.0  # healthy PCIe-class link
+    tel.observe_batch({"image": np.zeros((16, 32, 32, 3), np.uint8)})
+    for s in range(1, 5):  # past the warm-up skip: really evaluated
+        tel.on_step(s, {"loss": 1.0}, epoch=0, interval_s=0.1,
+                    dispatch_s=0.05)
+    tel.sink.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "J_telemetry_0.jsonl").read_text().splitlines()]
+    assert not any(r["kind"] == "warning" for r in rows)
+
+
+def test_link_bound_warning_survives_compile_inflated_first_steps(tmp_path):
+    """The first resolved intervals carry the jit compile (tens of seconds)
+    — staging looks negligible against them. A one-shot check armed there
+    would be permanently suppressed on exactly the link-bound runs it
+    exists for; the warm-up skip keeps the diagnosis alive until
+    steady-state intervals arrive."""
+    tel = _bare_tel(tmp_path)
+    tel.h2d_mbps = 10.0
+    tel.observe_batch({"image": np.zeros((256, 224, 224, 3), np.uint8)})
+    # steps 1-2: compile-inflated intervals where staging is <50%
+    for s in (1, 2):
+        tel.on_step(s, {"loss": 1.0}, epoch=0, interval_s=60.0,
+                    dispatch_s=59.0)
+    # steady state: staging dominates — the warning must still fire
+    tel.on_step(3, {"loss": 1.0}, epoch=0, interval_s=0.1, dispatch_s=0.05)
+    tel.sink.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "J_telemetry_0.jsonl").read_text().splitlines()]
+    warns = [r for r in rows if r["kind"] == "warning"]
+    assert len(warns) == 1 and warns[0]["tag"] == "h2d_link_bound"

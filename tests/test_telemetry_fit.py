@@ -196,3 +196,33 @@ def test_fit_telemetry_respects_config_toggles(tmp_path):
     assert "health" not in kinds and "mfu" not in kinds
     assert "step_breakdown" not in kinds and "run_meta" not in kinds
     assert "run_summary" in kinds
+
+
+def test_fit_reduce_streams_comm_rows(tmp_path):
+    """fit(reduce='quantized', telemetry=...): the one-time `comm` setup row
+    (bucket geometry + measured standalone probe) lands in the stream, and
+    every step_breakdown row carries the comm column pair — comm_bytes from
+    the compiled step's metrics via the delayed fetch, comm_s from the
+    probe."""
+    state, losses = fit(
+        _tiny_lm(), optax.adam(1e-3), _loader(), epochs=3, job_id="CR",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path), profile=False,
+        reduce="quantized", telemetry=TelemetryConfig(sentry=False),
+    )
+    assert state.comm_residual is not None
+    rows = _rows(tmp_path / "CR_telemetry_0.jsonl")
+    comm = [r for r in rows if r["kind"] == "comm"]
+    assert len(comm) == 1
+    assert comm[0]["method"] == "quantized" and comm[0]["world"] == 8
+    assert comm[0]["probe_s"] > 0
+    # the ≥3x wire-compression claim, recorded per run
+    assert comm[0]["fp32_bytes_per_step"] >= 3 * comm[0]["bytes_per_step"]
+    bd = [r for r in rows if r["kind"] == "step_breakdown"]
+    assert bd
+    for r in bd:
+        assert r["comm_bytes"] == comm[0]["bytes_per_step"]
+        assert r["comm_s"] == comm[0]["probe_s"]
+    # health rows see the dequantized-grad counters, still clean ints
+    health = [r for r in rows if r["kind"] == "health"]
+    assert health and all(r["nonfinite_grad_count"] == 0 for r in health)

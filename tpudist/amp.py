@@ -73,6 +73,22 @@ def all_finite(tree) -> jax.Array:
     return jnp.stack(leaves).all()
 
 
+def nonfinite_count(tree) -> jax.Array:
+    """int32 scalar: how many elements across the float leaves of ``tree``
+    are non-finite. The telemetry health metric's counter — one home, so
+    the compiled step and any future consumer (e.g. the explicit-reduction
+    path's detection on dequantized grads) count the same way. Non-float
+    leaves don't count (they cannot hold NaN/inf)."""
+    counts = [
+        jnp.sum(~jnp.isfinite(x))
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+    if not counts:
+        return jnp.zeros((), jnp.int32)
+    return jnp.asarray(sum(counts), jnp.int32)
+
+
 def skip_nonfinite(tx: optax.GradientTransformation) -> optax.GradientTransformation:
     """Wrap an optimizer so steps with non-finite gradients become no-ops.
 

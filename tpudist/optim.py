@@ -263,6 +263,16 @@ def shard_state(
     what makes it ZeRO-1. Leaves below ``min_size`` elements stay
     replicated (same threshold rule as ``fsdp_spec``).
 
+    Explicit gradient reduction (``make_train_step(reduce=...)``,
+    ``tpudist.parallel.dp``) composes from the OUTSIDE: the reducer hands
+    this wrapper replicated, already-dequantized mean gradients, so XLA's
+    weight-update-sharding decomposition inserts no second gradient
+    collective — the update math runs on the sharded moments (the grads
+    slice for free) and only the params-shaped update all-gather that
+    ZeRO-1 always pays remains. Net wire bytes: ~0.5× fp32-AR for the int8
+    grad reduction + 1× for the update all-gather, vs 2× for the implicit
+    fp32 rs+ag — docs/PERF.md §11 carries the full budget table.
+
     Checkpoints hold the stored (sharded/padded) layout; resuming needs the
     same world size, which the geometry guard in ``fit()`` already
     enforces.

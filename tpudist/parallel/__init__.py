@@ -1,19 +1,23 @@
 """Parallelism strategies.
 
 The reference's only strategy is data parallelism (DDP, SURVEY.md §2.12).
-DP has no module here because its shardings ARE the framework defaults:
+Default DP needs no module: its shardings ARE the framework defaults —
 params replicated (``tpudist.mesh.replicated_sharding``), batch split over
 the ``data`` axis (``tpudist.mesh.batch_sharding``), consumed directly by
 ``make_train_step`` — the gradient all-reduce is implicit in ``jax.grad``
-of a global-batch mean under GSPMD. This package holds the strategies
-BEYOND parity (tp/pp/cp/ep/fsdp) over the mesh's extra named axes.
+of a global-batch mean under GSPMD. ``dp`` holds the EXPLICIT reduction
+path for DCN-bound meshes (bucketed / int8-quantized gradient all-reduce,
+``make_train_step(reduce=...)``); the rest of the package is the
+strategies BEYOND parity (tp/pp/cp/ep/fsdp) over the mesh's extra axes.
 """
 
+from tpudist.parallel.dp import GradReducer, make_reducer, resolve_method
 from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch
 from tpudist.parallel.fsdp import fsdp_shardings, shard_state
 from tpudist.parallel.pp import pipeline_apply, stacked_param_shardings
 
 __all__ = [
+    "GradReducer", "make_reducer", "resolve_method",
     "fsdp_shardings", "shard_state",
     "pipeline_apply", "stacked_param_shardings",
     "MoEMlp", "expert_capacity", "top_k_dispatch",

@@ -1,0 +1,401 @@
+"""Explicit data-parallel gradient reduction: the DDP Reducer, TPU-native.
+
+The framework default leaves the gradient all-reduce to XLA: the train step's
+loss is the mean over the *global* batch, so ``jax.grad`` produces
+already-reduced gradients and GSPMD inserts (and overlaps) the psum — the
+right call on an ICI-only mesh, where the compiler's scheduling beats
+anything hand-rolled. On a multi-slice pod the ``data`` axis crosses DCN and
+the fp32 reduction becomes the dominant step-time term (arXiv:2204.06514);
+this module is the opt-in explicit path for exactly that regime
+(``make_train_step(reduce=...)``):
+
+- gradients are computed PER REPLICA inside one ``shard_map`` over the
+  ``data`` axis (the loss is the local-shard mean; its cross-replica mean —
+  one scalar psum — reproduces the global-batch loss exactly);
+- they are flattened into fixed-size buckets (:class:`tpudist.comm
+  .BucketLayout` — the DDP-bucket equivalent) and all-reduced explicitly:
+  ``"bucketed"`` as fp32 psum (isolates the restructuring), ``"quantized"``
+  as int8 on the wire with per-bucket scales, stochastic rounding, fp32
+  master accumulation, and an error-feedback residual carried in the train
+  state (:func:`tpudist.comm.ring_allreduce_quantized` — the EQuARX recipe,
+  arXiv:2506.17615) so convergence tracks fp32 within tolerance;
+- with ``grad_accum > 1`` the reduction is double-buffered inside the
+  accumulation scan: iteration ``i`` reduces microbatch ``i-1``'s buckets
+  while computing microbatch ``i``'s forward/backward — the two have no
+  data dependency, so XLA's scheduler overlaps the collective with compute
+  (the async-bucket overlap DDP's C++ Reducer implements with hooks). The
+  first iteration reduces the zero-initialized pending buffer, which doubles
+  as the residual flush; one final reduction after the scan drains the last
+  microbatch — ``grad_accum + 1`` reductions per step. Configurations
+  WITHOUT a residual (``"bucketed"``, or ``error_feedback=False``) have
+  nothing to flush and nothing the overlap's extra bytes would buy: they
+  accumulate locally and reduce once after the scan — the implicit path's
+  schedule, explicit. docs/PERF.md §11 carries the honest byte math of the
+  EF path's trade (int8 pays for the extra reductions; fp32 would not).
+
+Semantics vs the implicit path: identical gradients for ``"bucketed"`` (up
+to fp32 reduction order) for deterministic forwards; models with
+``dropout > 0`` draw independent per-REPLICA masks (the step key folded
+with ``axis_index`` — DDP's exact dropout semantics) instead of the
+implicit path's one global-batch draw, so dropout trajectories are
+equivalent in distribution, not bitwise. ``"quantized"`` adds zero-mean
+quantization noise bounded by the per-bucket scale, compensated across
+steps by the residual.
+Batch-norm: inside ``shard_map`` each replica computes LOCAL batch
+statistics and the updated running stats are psum-averaged — the mean of
+per-shard means IS the global batch mean (equal shards), the variance is
+the DDP-default within-shard variance, not SyncBN's global one. ZeRO-1
+(``shard_opt_state``) composes: grads come back replicated and dequantized,
+so XLA's weight-update-sharding decomposition adds only the params
+all-gather ZeRO-1 already pays — no second gradient reduction.
+
+Restrictions (enforced loudly): pure DP only — params replicated, ``fsdp``
+axis size 1, no ``batch_spec`` overrides (context-parallel models keep the
+implicit path), no ``"_"``-prefixed device operands (DeviceCachedLoader
+rides the implicit path), and models must NOT wrap their own ``shard_map``
+(pass ``mesh=None`` to the model zoo: inside the reduction's manual region
+the batch is already local, which is exactly what the kernels want).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist import comm
+from tpudist.mesh import DATA_AXIS, FSDP_AXIS
+from tpudist.utils.compat import shard_map
+
+METHODS = ("none", "bucketed", "quantized", "auto")
+
+
+def resolve_method(method: str, mesh: Mesh) -> str:
+    """``"auto"`` → ``"quantized"`` when THIS mesh's ``data`` axis crosses
+    DCN, ``"none"`` otherwise — on an ICI-only reduction the implicit XLA
+    psum is already bandwidth-optimal in fp32 and the quantization would
+    spend quality on bytes nothing is short of. The check walks one
+    data-axis column of ``mesh.devices`` (not ``jax.devices()``: a mesh
+    confined to one slice of a multi-slice attach — the other slice held
+    by another job, or mapped to a model axis — reduces over ICI and must
+    stay on the implicit path). A mesh with one ``data`` replica has
+    nothing to reduce: always ``"none"``."""
+    if method not in METHODS:
+        raise ValueError(f"reduce must be one of {METHODS}, got {method!r}")
+    if int(mesh.shape[DATA_AXIS]) <= 1:
+        return "none"
+    if method == "auto":
+        import numpy as np
+
+        data_column = np.asarray(mesh.devices).reshape(
+            int(mesh.shape[DATA_AXIS]), -1
+        )[:, 0]
+        return "quantized" if comm.multislice_dcn(data_column) else "none"
+    return method
+
+
+class GradReducer:
+    """The explicit-reduction engine ``make_train_step(reduce=...)`` builds.
+
+    Holds the static configuration (mesh, method, bucket size, error
+    feedback, stochastic-rounding seed); the bucket layout is derived on
+    demand from whatever params tree it is shown (concrete, tracer, or
+    eval_shape — same shapes, same layout), so construction needs no
+    params.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        method: str,
+        *,
+        bucket_size: int = comm.DEFAULT_BUCKET_ELEMS,
+        error_feedback: bool = True,
+        seed: int = 0,
+    ):
+        if method not in ("bucketed", "quantized"):
+            raise ValueError(
+                f"GradReducer method must be 'bucketed' or 'quantized', got "
+                f"{method!r} (resolve 'auto' via resolve_method first)"
+            )
+        if int(mesh.shape[FSDP_AXIS]) != 1:
+            raise ValueError(
+                "explicit gradient reduction is pure-DP: it requires "
+                f"replicated params, but the mesh has fsdp="
+                f"{int(mesh.shape[FSDP_AXIS])} — use the implicit path for "
+                "FSDP (XLA already reduce-scatters per layer there)"
+            )
+        self.mesh = mesh
+        self.method = method
+        self.bucket_size = int(bucket_size)
+        # error feedback only means something when the wire is lossy
+        self.error_feedback = bool(error_feedback) and method == "quantized"
+        self.seed = int(seed)
+        self.world = int(mesh.shape[DATA_AXIS])
+
+    # -- layout / residual -------------------------------------------------
+
+    def layout_for(self, params) -> comm.BucketLayout:
+        return comm.BucketLayout(
+            params, self.world, bucket_size=self.bucket_size
+        )
+
+    def attach_residual(self, state):
+        """Return ``state`` with a zeroed error-feedback residual in
+        ``comm_residual`` — ``[world, n_buckets, bucket_size]`` fp32,
+        sharded over ``data`` so each replica stores only its own slice
+        (the residual is PER-REPLICA local state: each replica's
+        quantization error differs). Allocated sharded directly on the
+        devices; the full array never exists on the host. No-op when the
+        method needs no residual."""
+        if not self.error_feedback:
+            return state
+        layout = self.layout_for(state.params)
+        sh = self.residual_sharding()
+        shape = (self.world, layout.n_buckets, layout.bucket_size)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, jnp.float32), out_shardings=sh
+        )()
+        return state.replace(comm_residual=zeros)
+
+    def residual_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    # -- the in-step compute path -----------------------------------------
+
+    def compute(self, grad_fn: Callable, params, batch_stats, rows, step,
+                residual, grad_accum: int):
+        """The explicit-path replacement for the train step's gradient
+        block: local forward/backward per replica, explicit bucket
+        reduction, replicated outputs.
+
+        ``grad_fn``: ``(params, stats, batch, step) → ((loss, new_stats),
+        grads)`` — exactly ``make_train_step``'s ``value_and_grad``.
+        ``rows``: the staged batch dict (global arrays; leading dim —
+        second with ``grad_accum > 1`` — sharded over ``data``). Returns
+        ``(loss, grads, new_stats, new_residual)``, all replicated except
+        the residual (``None`` when error feedback is off); grads are the
+        cross-replica mean, dequantized — the values every downstream
+        consumer (optimizer, non-finite guard, telemetry norms) sees.
+        """
+        layout = self.layout_for(params)
+        use_ef = self.error_feedback
+        if use_ef and residual is None:
+            raise ValueError(
+                "reduce='quantized' with error feedback needs the residual "
+                "in the train state — initialize it once with "
+                "step.grad_reducer.attach_residual(state) (fit() does this "
+                "automatically)"
+            )
+        axis, method, world, seed = DATA_AXIS, self.method, self.world, self.seed
+
+        def local(params, stats, rows, step, res):
+            # res: [1, n_buckets, bucket_size] block (or a zeros dummy when
+            # EF is off — kept in the signature so both variants share one
+            # spec tuple)
+            r = res[0] if use_ef else None
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(seed), step),
+                jax.lax.axis_index(axis),
+            )
+            if grad_accum == 1:
+                (loss, new_stats), g = grad_fn(params, stats, rows, step)
+                mean, r = comm.reduce_buckets(
+                    layout.flatten(g), r, layout, axis,
+                    jax.random.fold_in(key, 0), method=method,
+                )
+            elif use_ef:
+                zeros = jnp.zeros(
+                    (layout.n_buckets, layout.bucket_size), jnp.float32
+                )
+
+                def micro(carry, xs):
+                    pending, rsum, stats, lsum, r = carry
+                    mb, i = xs
+                    # double buffer: reduce microbatch i-1's buckets (no
+                    # data dependency on this iteration's grad_fn, so XLA
+                    # overlaps the collective with the forward/backward);
+                    # i=0 reduces the zero init, which flushes the residual
+                    reduced, r = comm.reduce_buckets(
+                        pending, r, layout, axis,
+                        jax.random.fold_in(key, i), method=method,
+                    )
+                    rsum = rsum + reduced
+                    (l, stats), g = grad_fn(
+                        params, stats, mb, step * grad_accum + i
+                    )
+                    return (layout.flatten(g), rsum, stats, lsum + l, r), None
+
+                carry = (zeros, zeros, stats, jnp.zeros((), jnp.float32), r)
+                (pending, rsum, new_stats, lsum, r), _ = jax.lax.scan(
+                    micro, carry, (rows, jnp.arange(grad_accum))
+                )
+                # drain the last microbatch's pending buckets
+                reduced, r = comm.reduce_buckets(
+                    pending, r, layout, axis,
+                    jax.random.fold_in(key, grad_accum), method=method,
+                )
+                mean = (rsum + reduced) / grad_accum
+                loss = lsum / grad_accum
+            else:
+                # no residual to flush (bucketed, or EF off): the zeroth
+                # double-buffer reduction would move a full bucket set of
+                # exact zeros — accumulate locally instead and reduce ONCE
+                # after the scan (the implicit path's schedule, explicit).
+                # Per-micro overlap is the quantized+EF path's trade; here
+                # it would only buy accum× the bytes for nothing.
+                def micro(carry, xs):
+                    gsum, stats, lsum = carry
+                    mb, i = xs
+                    (l, stats), g = grad_fn(
+                        params, stats, mb, step * grad_accum + i
+                    )
+                    return (gsum + layout.flatten(g), stats, lsum + l), None
+
+                zeros = jnp.zeros(
+                    (layout.n_buckets, layout.bucket_size), jnp.float32
+                )
+                (gsum, new_stats, lsum), _ = jax.lax.scan(
+                    micro, (zeros, stats, jnp.zeros((), jnp.float32)),
+                    (rows, jnp.arange(grad_accum)),
+                )
+                mean, r = comm.reduce_buckets(
+                    gsum, None, layout, axis,
+                    jax.random.fold_in(key, 0), method=method,
+                )
+                mean = mean / grad_accum
+                loss = lsum / grad_accum
+            # scalar psum: the cross-replica mean of local-shard means IS
+            # the global-batch mean (equal shards by construction)
+            loss = jax.lax.psum(loss, axis) / world
+            # running BN stats: mean-of-means is the exact global batch
+            # mean; variance stays within-shard (DDP-default, not SyncBN)
+            new_stats = jax.tree_util.tree_map(
+                lambda s: jax.lax.psum(s, axis) / world, new_stats
+            )
+            res_out = r[None] if use_ef else res
+            return loss, mean, new_stats, res_out
+
+        if use_ef:
+            res_in = residual
+        else:
+            # structural dummy so the EF-on and EF-off programs share one
+            # signature; [world, 1, 1] keeps it a few bytes per replica
+            res_in = jnp.zeros((world, 1, 1), jnp.float32)
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(), P(None, axis) if grad_accum > 1 else P(axis),
+                      P(), P(axis)),
+            out_specs=(P(), P(), P(), P(axis)),
+            check_vma=False,
+        )
+        loss, mean_buckets, new_stats, res_out = fn(
+            params, batch_stats, rows, step, res_in
+        )
+        grads = layout.unflatten(mean_buckets)
+        return loss, grads, new_stats, (res_out if use_ef else None)
+
+    # -- accounting / probing ---------------------------------------------
+
+    def reductions_per_step(self, grad_accum: int) -> int:
+        # the double-buffered EF scan reduces per microbatch plus the
+        # residual flush; without a residual the step accumulates locally
+        # and reduces once (no zeros-flush collective to pay for)
+        if grad_accum == 1 or not self.error_feedback:
+            return 1
+        return grad_accum + 1
+
+    def comm_stats(self, params, grad_accum: int = 1) -> dict[str, Any]:
+        """Host-side wire accounting for one step at this configuration:
+        the actual method's bytes, the same-schedule fp32 bytes (the
+        apples-to-apples A/B the ≥3× compression claim is quoted against),
+        and the single-AR fp32 bytes XLA's implicit path would move (the
+        absolute baseline — with microbatch overlap the explicit path
+        trades some of its 4× bytes win for latency hiding)."""
+        layout = self.layout_for(params)
+        r = self.reductions_per_step(grad_accum)
+        return {
+            "method": self.method,
+            "world": self.world,
+            "bucket_size": layout.bucket_size,
+            "n_buckets": layout.n_buckets,
+            "grad_elems": layout.total,
+            "error_feedback": self.error_feedback,
+            "reductions_per_step": r,
+            "bytes_per_step": layout.wire_bytes(self.method, reductions=r),
+            "fp32_bytes_per_step": layout.wire_bytes("bucketed", reductions=r),
+            "implicit_fp32_bytes_per_step": layout.wire_bytes(
+                "bucketed", reductions=1
+            ),
+        }
+
+    def time_probe(self, params, grad_accum: int = 1, iters: int = 3) -> float:
+        """Measured seconds of one step's reductions, STANDALONE: the
+        reduce-only program (no model compute to overlap with) run on
+        zeroed buckets, synced by value fetch. An upper bound on the
+        per-step comm cost — with the double-buffered scan, part of it
+        hides behind the microbatch compute. This is the ``comm`` column
+        fit()'s step-time breakdown carries; one small compile, run once
+        at bring-up."""
+        layout = self.layout_for(params)
+        axis, method, seed, use_ef = (
+            DATA_AXIS, self.method, self.seed, self.error_feedback
+        )
+
+        def local(buckets, res):
+            key = jax.random.fold_in(
+                jax.random.key(seed), jax.lax.axis_index(axis)
+            )
+            mean, r = comm.reduce_buckets(
+                buckets[0], res[0] if use_ef else None, layout, axis, key,
+                method=method,
+            )
+            return mean, (r[None] if use_ef else res)
+
+        fn = jax.jit(shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(axis), P(axis)), out_specs=(P(), P(axis)),
+            check_vma=False,
+        ))
+        shape = (self.world, layout.n_buckets, layout.bucket_size)
+        sh = self.residual_sharding()
+        buckets = jax.jit(
+            lambda: jnp.zeros(shape, jnp.float32), out_shardings=sh
+        )()
+        res = buckets if use_ef else jax.jit(
+            lambda: jnp.zeros((self.world, 1, 1), jnp.float32),
+            out_shardings=sh,
+        )()
+        best = float("inf")
+        for _ in range(max(iters, 1) + 1):  # first run includes the compile
+            t0 = time.perf_counter()
+            mean, res = fn(buckets, res)
+            float(mean[0, 0])  # value-fetch sync (bench.py's probe rule)
+            best = min(best, time.perf_counter() - t0)
+        return best * self.reductions_per_step(grad_accum)
+
+
+def make_reducer(
+    reduce: "str | GradReducer",
+    mesh: Mesh,
+    *,
+    bucket_size: int = comm.DEFAULT_BUCKET_ELEMS,
+    error_feedback: bool = True,
+    seed: int = 0,
+) -> GradReducer | None:
+    """``make_train_step``'s constructor: a method name (``"none"`` /
+    ``"bucketed"`` / ``"quantized"`` / ``"auto"``) or an already-built
+    :class:`GradReducer` → the reducer to use, or ``None`` for the implicit
+    XLA path (``"none"``, ``"auto"`` off DCN, or a 1-replica mesh)."""
+    if isinstance(reduce, GradReducer):
+        return reduce
+    method = resolve_method(reduce, mesh)
+    if method == "none":
+        return None
+    return GradReducer(
+        mesh, method,
+        bucket_size=bucket_size, error_feedback=error_feedback, seed=seed,
+    )

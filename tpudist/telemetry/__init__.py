@@ -130,7 +130,9 @@ class TelemetrySink:
     ``{"v": 1, "t": <unix seconds>, "kind": ..., "rank": ..., "step": ...,
     <kind-specific fields>}``. Kinds written by ``fit()``: ``health``,
     ``step_breakdown``, ``mfu``, ``throughput``, ``memory``, ``anomaly``,
-    ``heartbeat``, ``train_time``, ``run_meta``. Schema glossary in
+    ``heartbeat``, ``train_time``, ``run_meta``, ``comm`` (explicit
+    gradient reduction's one-time wire accounting), ``warning`` (tagged
+    one-shot diagnoses, e.g. ``h2d_link_bound``). Schema glossary in
     docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
     APPEND mode — both halves of the flight-recorder contract: the anomaly
     row must survive the crash it describes, including a checkpoint-resume
@@ -310,12 +312,58 @@ class Telemetry:
         self._flops_per_step: float | None = None
         self._tokens_per_step: int | None = None
         self._sized = False
+        # explicit-gradient-reduction accounting (tpudist.parallel.dp):
+        # set_comm() fills these; step_breakdown rows then carry the comm
+        # column. None ⇒ feature off ⇒ rows byte-identical to before.
+        self._comm: dict | None = None
+        self._comm_probe_s: float | None = None
+        # H2D link probe (MB/s, fit() fills on accelerator backends) + the
+        # staged-batch byte count observe_batch measures: together they
+        # decide the one-shot link-bound warning row
+        self.h2d_mbps: float | None = None
+        self._batch_bytes: int | None = None
+        self._link_warned = False
+        self._link_checks = 0
 
     # -- wiring ------------------------------------------------------------
 
+    def set_comm(self, stats: Mapping[str, Any] | None,
+                 probe_s: float | None = None) -> None:
+        """Attach the explicit-reduction wire accounting
+        (``GradReducer.comm_stats``) and the measured standalone
+        reduce-only probe. Rank 0 writes a one-time ``comm`` row so the
+        stream is self-describing: per-step rows carry only the live
+        numbers, the setup row carries the method/bucket geometry and the
+        fp32-equivalent bytes the compression is quoted against."""
+        if not stats:
+            return
+        self._comm = dict(stats)
+        self._comm_probe_s = probe_s
+        if self.rank == 0:
+            self.sink.write(
+                "comm",
+                probe_s=None if probe_s is None else round(probe_s, 6),
+                **self._comm,
+            )
+
     def observe_batch(self, batch: Mapping[str, Any]) -> None:
         """Size the MFU numerator from the first staged batch's GLOBAL
-        shapes (once; analytic counters, no device work)."""
+        shapes (once; analytic counters, no device work). Also records the
+        staged batch's PER-HOST byte volume — the numerator of the
+        link-bound check: staged arrays are global, but each host only
+        ships its own shard over its own link, so the global nbytes must
+        be divided by the process count or an 8-host run would see an
+        8x-inflated staging estimate and warn on healthy links."""
+        if self._batch_bytes is None:
+            try:
+                import jax as _jax
+
+                self._batch_bytes = int(sum(
+                    v.nbytes for k, v in batch.items()
+                    if not k.startswith("_") and hasattr(v, "nbytes")
+                ) / max(_jax.process_count(), 1))
+            except Exception:
+                self._batch_bytes = 0
         if self._sized or not self.config.mfu:
             return
         self._sized = True
@@ -359,6 +407,24 @@ class Telemetry:
             if health:
                 self.sink.write("health", step, loss=loss, **health)
             if self.config.breakdown and dispatch_s is not None:
+                extra = {}
+                if self._comm is not None:
+                    # the comm column: the setup row's exact host integer
+                    # is preferred over the compiled step's fp32 metric
+                    # (whose 24-bit mantissa rounds GB-scale counts by up
+                    # to ~128 bytes); the time is the one-shot standalone
+                    # probe — an unoverlapped upper bound, not a per-step
+                    # measurement (in-graph collectives cannot be timed
+                    # from the host without a barrier)
+                    extra = {
+                        "comm_bytes": self._comm.get(
+                            "bytes_per_step", metrics.get("comm_bytes")
+                        ),
+                        "comm_s": (
+                            None if self._comm_probe_s is None
+                            else round(self._comm_probe_s, 6)
+                        ),
+                    }
                 self.sink.write(
                     "step_breakdown", step,
                     interval_s=round(interval_s, 6),
@@ -368,6 +434,7 @@ class Telemetry:
                     # block_until_ready there would stall the pipeline
                     # every step); null on the rest
                     device_s=None if device_s is None else round(device_s, 6),
+                    **extra,
                 )
             if self._flops_per_step is not None and interval_s > 0:
                 self.sink.write(
@@ -384,6 +451,46 @@ class Telemetry:
                         None if self._tokens_per_step is None
                         else round(self._tokens_per_step / interval_s, 2)
                     ),
+                )
+
+        if (not self._link_warned and self.h2d_mbps and self._batch_bytes
+                and interval_s > 0):
+            # link-bound diagnosis (docs/PERF.md §3): when just STAGING the
+            # batch at the probed H2D rate would eat more than half the
+            # observed step interval, the run is link-bound — a regime
+            # measured at 0.08× on the resnet50_e2e leg — and the framework
+            # mitigation is DeviceCachedLoader (stage the set to HBM once;
+            # per-step H2D becomes index-only). The first two resolved
+            # intervals are skipped (they carry the jit compile, which
+            # dwarfs any staging cost and would mask the diagnosis
+            # permanently); after warm-up every step is checked until the
+            # warning fires — a link can also COLLAPSE mid-run — and it
+            # fires at most once: tagged row + one stderr line instead of
+            # failing silently slow.
+            self._link_checks += 1
+            staging_s = self._batch_bytes / (self.h2d_mbps * 1e6)
+            if self._link_checks > 2 and staging_s > 0.5 * interval_s:
+                self._link_warned = True
+                import sys
+
+                self.sink.write(
+                    "warning", step, tag="h2d_link_bound",
+                    h2d_mbps=round(self.h2d_mbps, 1),
+                    batch_bytes=self._batch_bytes,
+                    est_staging_s=round(staging_s, 6),
+                    interval_s=round(interval_s, 6),
+                    hint="per-step H2D staging dominates the step; stage "
+                         "the dataset to HBM once with DeviceCachedLoader "
+                         "(docs/PERF.md §3b) or pack+cache for streaming "
+                         "sets (§3c)",
+                )
+                print(
+                    f"tpudist: H2D link-bound run (probe "
+                    f"{self.h2d_mbps:.0f} MB/s, batch "
+                    f"{self._batch_bytes / 1e6:.1f} MB ≈ {staging_s:.3f}s "
+                    f"of a {interval_s:.3f}s step) — consider "
+                    "DeviceCachedLoader (docs/PERF.md §3b)",
+                    file=sys.stderr, flush=True,
                 )
 
         event = None

@@ -46,6 +46,12 @@ class TrainState(struct.PyTreeNode):
     params: Any
     batch_stats: Any  # empty FrozenDict for models without BN
     opt_state: Any
+    # error-feedback residual of the explicit quantized gradient reduction
+    # (tpudist.parallel.dp) — [world, n_buckets, bucket_size] fp32 sharded
+    # over `data`, attached by GradReducer.attach_residual. None (the empty
+    # pytree: zero leaves, so checkpoints and shardings of residual-free
+    # states are untouched) everywhere else.
+    comm_residual: Any = None
 
 
 def cross_entropy_loss(logits, labels):
@@ -200,8 +206,38 @@ def make_train_step(
     input_transform: Callable | None = None,
     telemetry: bool = False,
     guard_nonfinite: bool = False,
+    reduce: Any = "none",
+    reduce_bucket_size: int | None = None,
+    error_feedback: bool = True,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
+
+    ``reduce`` selects the gradient-reduction path (``tpudist.parallel.dp``):
+    ``"none"`` (default) keeps the implicit XLA psum — optimal on ICI;
+    ``"bucketed"`` computes per-replica gradients inside a ``shard_map`` and
+    all-reduces them explicitly as fixed-size fp32 buckets (the DDP-Reducer
+    structure, exact); ``"quantized"`` additionally ships int8 on the wire —
+    per-bucket scales, stochastic rounding, fp32 master accumulation, and an
+    error-feedback residual carried in ``state.comm_residual`` (attach once
+    via ``step.grad_reducer.attach_residual(state)``; ``fit()`` does it) so
+    convergence tracks fp32 within tolerance; ``"auto"`` picks quantized on
+    a multi-slice (DCN-crossing) attach and none otherwise. A prebuilt
+    ``dp.GradReducer`` is accepted verbatim. With ``grad_accum > 1`` the
+    quantized+error-feedback reduction is double-buffered inside the
+    accumulation scan: microbatch ``i-1``'s buckets reduce while microbatch
+    ``i``'s forward/backward runs (residual-free configs accumulate locally
+    and reduce once after the scan).
+    The explicit path is pure-DP (replicated params, no ``batch_spec``, no
+    device-resident ``"_"`` operands — enforced loudly) and composes with
+    ZeRO-1 ``shard_opt_state``, ``amp.skip_nonfinite`` and
+    ``guard_nonfinite`` (both see the already-dequantized gradients; a
+    skipped step never poisons the residual). ``reduce_bucket_size``
+    overrides the bucket size in ELEMENTS (default
+    ``tpudist.comm.DEFAULT_BUCKET_ELEMS``); ``error_feedback=False`` drops
+    the residual (pure unbiased quantization noise — the A/B knob the
+    convergence tests pin down). The reducer is exposed as
+    ``step.grad_reducer`` (``None`` on the implicit path) and the wire
+    accounting as ``step.comm_stats(params)``.
 
     ``telemetry=True`` folds the in-step health metrics into the compiled
     program (tpudist.telemetry): global grad-norm, param-norm (pre-update),
@@ -260,6 +296,47 @@ def make_train_step(
     """
     batch_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
+    from tpudist.parallel import dp as dp_mod
+
+    reducer = dp_mod.make_reducer(
+        reduce, mesh,
+        **({} if reduce_bucket_size is None
+           else {"bucket_size": reduce_bucket_size}),
+        error_feedback=error_feedback, seed=dropout_seed,
+    )
+    if reducer is not None:
+        if batch_spec is not None:
+            raise ValueError(
+                "reduce=... is pure-DP and incompatible with batch_spec "
+                "overrides (context/sequence-parallel models keep the "
+                "implicit XLA reduction)"
+            )
+        if state_sharding is not None:
+            def _sharded_for_real(s):
+                # Megatron annotations on size-1 axes (the model zoo's
+                # inert TP specs) are replication in fact — only a spec
+                # naming an axis with >1 devices actually splits params
+                spec = getattr(s, "spec", P())
+                for part in spec:
+                    names = part if isinstance(part, tuple) else (part,)
+                    for name in names:
+                        if name is not None and mesh.shape[name] > 1:
+                            return True
+                return False
+
+            bad = [
+                s.spec for s in jax.tree_util.tree_leaves(
+                    getattr(state_sharding, "params", state_sharding)
+                )
+                if _sharded_for_real(s)
+            ]
+            if bad:
+                raise ValueError(
+                    "reduce=... requires fully-replicated params (pure DP); "
+                    f"got param shardings {bad[:3]} — TP/FSDP models keep "
+                    "the implicit XLA reduction"
+                )
+
     # models that sow auxiliary losses (e.g. MoE load-balance,
     # parallel/ep.py) declare it via ``has_aux_loss``; duck-typed models
     # without the attribute keep the plain (non-mutable) apply path
@@ -281,7 +358,19 @@ def make_train_step(
         )
         kwargs = {}
         if dropout_rate > 0:
-            kwargs["rngs"] = {"dropout": jax.random.fold_in(dropout_base, step)}
+            key = jax.random.fold_in(dropout_base, step)
+            if reducer is not None:
+                # inside the explicit path's shard_map each replica sees
+                # only its local batch rows; the step-derived key alone
+                # would draw the SAME mask on every replica (row i of every
+                # shard sharing noise — W-fold less mask diversity than the
+                # implicit path's one global-batch draw). Folding in the
+                # replica index restores independent per-rank masks — DDP's
+                # exact dropout semantics.
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(mesh_lib.DATA_AXIS)
+                )
+            kwargs["rngs"] = {"dropout": key}
         if mutable:
             logits, updates = model.apply(
                 variables, inputs, train=True, mutable=mutable, **kwargs
@@ -311,7 +400,23 @@ def make_train_step(
     grad_fn = jax.value_and_grad(forward, has_aux=True)
 
     def step_fn(state: TrainState, batch):
-        if grad_accum == 1:
+        new_residual = state.comm_residual
+        if reducer is not None:
+            bad_keys = sorted(k for k in batch if k.startswith("_"))
+            if bad_keys:
+                raise ValueError(
+                    f"batch carries device-resident operands {bad_keys}, "
+                    "which the explicit-reduction path does not stage into "
+                    "its shard_map — use the implicit path (reduce='none') "
+                    "with DeviceCachedLoader"
+                )
+            loss, grads, new_stats, ef_res = reducer.compute(
+                grad_fn, state.params, state.batch_stats, batch, state.step,
+                state.comm_residual, grad_accum,
+            )
+            if ef_res is not None:
+                new_residual = ef_res
+        elif grad_accum == 1:
             (loss, new_stats), grads = grad_fn(
                 state.params, state.batch_stats, batch, state.step
             )
@@ -348,19 +453,45 @@ def make_train_step(
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if reducer is not None and reducer.error_feedback:
+            # a non-finite step (bf16 spike, data glitch) must not bank its
+            # garbage into the error-feedback residual: whether the update
+            # itself is rejected by guard_nonfinite, amp.skip_nonfinite, or
+            # nothing at all, the residual reverts — detection on the
+            # DEQUANTIZED grads, the same values every other consumer sees
+            from tpudist.amp import all_finite as _all_finite
+
+            res_ok = jnp.isfinite(loss) & _all_finite(grads)
+            new_residual = jnp.where(
+                res_ok, new_residual, state.comm_residual
+            )
         # loss is the global-batch mean — the in-graph equivalent of the
         # reference's post-step reduce_loss (main.py:105)
         metrics = {"loss": loss}
+        if reducer is not None:
+            # wire bytes this step's reductions move per replica — a static
+            # constant, but carried as a metric so it rides the existing
+            # one-step-delayed fetch with the other step scalars. fp32's
+            # 24-bit mantissa rounds GB-scale counts; exact-integer
+            # consumers (the telemetry rows) read comm_stats() instead
+            metrics["comm_bytes"] = jnp.asarray(
+                reducer.layout_for(state.params).wire_bytes(
+                    reducer.method,
+                    reductions=reducer.reductions_per_step(grad_accum),
+                ),
+                jnp.float32,
+            )
         if telemetry:
             # health metrics inside the same compiled program: these are
             # full-tree reductions over values the step already holds, so
             # XLA schedules them alongside the backward pass and the only
             # addition to the metrics fetch is four more scalars on the
-            # existing one-step-delayed async path
-            nonfinite = jnp.asarray(sum(
-                jnp.sum(~jnp.isfinite(g))
-                for g in jax.tree_util.tree_leaves(grads)
-            ), jnp.int32)
+            # existing one-step-delayed async path. On the explicit-
+            # reduction path `grads` is the dequantized cross-replica mean,
+            # so the count sees exactly what the optimizer sees.
+            from tpudist.amp import nonfinite_count
+
+            nonfinite = nonfinite_count(grads)
             metrics.update(
                 grad_norm=optax.global_norm(grads),
                 param_norm=optax.global_norm(state.params),
@@ -400,11 +531,24 @@ def make_train_step(
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt,
+            comm_residual=new_residual,
         )
         return new_state, metrics
 
     repl = mesh_lib.replicated_sharding(mesh)
     out_state_sharding = state_sharding if state_sharding is not None else repl
+    if reducer is not None and reducer.error_feedback:
+        # the residual is PER-REPLICA state — forcing it under the default
+        # replicated sharding would all-gather world× copies onto every
+        # chip; pin its leaf to the data-sharded layout it was born with
+        res_sh = reducer.residual_sharding()
+        if state_sharding is None:
+            out_state_sharding = TrainState(
+                step=repl, params=repl, batch_stats=repl, opt_state=repl,
+                comm_residual=res_sh,
+            )
+        else:
+            out_state_sharding = state_sharding.replace(comm_residual=res_sh)
 
     def batch_sh(key, x):
         if batch_spec is not None and key in batch_spec:
@@ -441,6 +585,11 @@ def make_train_step(
     )
     compiled.jitted = _jitted
     compiled.stage = stage
+    compiled.grad_reducer = reducer
+    compiled.comm_stats = (
+        None if reducer is None
+        else lambda params: reducer.comm_stats(params, grad_accum)
+    )
     return compiled
 
 
@@ -462,6 +611,7 @@ def fit(
     grad_accum: int = 1,
     remat: bool | str = False,
     shard_opt_state: bool = False,
+    reduce: str = "none",
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
     input_transform: Callable | None = None,
@@ -503,6 +653,17 @@ def fit(
     rows) during training: ``None`` (default) auto-selects ``log_every·10``
     steps on backends that report allocator stats and off on those that
     don't (CPU); ``0`` disables; ``N`` forces a cadence.
+
+    ``reduce`` selects the gradient-reduction path (see
+    :func:`make_train_step`): ``"none"`` (default, implicit XLA psum),
+    ``"bucketed"`` / ``"quantized"`` (explicit bucketed all-reduce, fp32 or
+    int8-on-the-wire with error feedback — the DCN-bound data-parallel
+    lever, docs/PERF.md §11), ``"auto"`` (quantized on a multi-slice
+    attach). fit() attaches the error-feedback residual to the train state,
+    records the method in the checkpoint geometry meta, and — with
+    telemetry on — streams per-step comm bytes plus a one-time measured
+    comm-time probe into the JSONL sink (a ``comm`` column on the step-time
+    breakdown rows; rows are unchanged when the feature is off).
 
     ``shard_opt_state=True`` wraps ``tx`` in ZeRO-1 cross-replica
     optimizer-state sharding (``tpudist.optim.shard_state``): the Adam
@@ -583,13 +744,17 @@ def fit(
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
         forward_loss=forward_loss, dropout_seed=seed,
-        input_transform=input_transform,
+        input_transform=input_transform, reduce=reduce,
         **(tel_cfg.step_kwargs() if tel_cfg else {}),
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
         # here would all-gather a TP model's params on the first step
         state_sharding=state_shardings_of(state),
     )
+    if step.grad_reducer is not None:
+        # error-feedback residual born sharded over the data replicas
+        # (no-op for methods that carry none)
+        state = step.grad_reducer.attach_residual(state)
 
     # sized loaders only matter for resume math; a re-iterable loader without
     # __len__ still trains as long as checkpointing is off
@@ -612,6 +777,12 @@ def fit(
         # so instead. Only recorded when on, so replicated runs' meta (and
         # their resumability) is unchanged.
         run_meta["shard_opt_state"] = True
+    if step.grad_reducer is not None:
+        # same geometry rule for the explicit-reduction path: the
+        # error-feedback residual's [world, ...] layout (and the stochastic
+        # rounding stream) is world-size-bound — resuming a quantized run
+        # replicated (or vice versa) must refuse, not silently diverge
+        run_meta["reduce"] = step.grad_reducer.method
     ckpt = None
     start_step = 0
     losses: list[float] = []
@@ -674,6 +845,27 @@ def fit(
             )
             if tel is not None:
                 logger.attach_sink(tel.sink)
+                if step.grad_reducer is not None:
+                    # one-time comm accounting + a measured standalone
+                    # probe of the reduce-only program: the `comm` column
+                    # the step-time breakdown rows carry (an unoverlapped
+                    # upper bound; per-step comm BYTES additionally ride
+                    # the compiled step's metrics through the delayed
+                    # fetch)
+                    tel.set_comm(
+                        step.comm_stats(state.params),
+                        probe_s=step.grad_reducer.time_probe(
+                            state.params, grad_accum
+                        ),
+                    )
+                if jax.default_backend() != "cpu":
+                    # H2D link probe: one 8 MB staged buffer measures what
+                    # the attach link sustains, so a link-bound run gets a
+                    # tagged warning row pointing at DeviceCachedLoader
+                    # instead of failing silently slow (docs/PERF.md §3)
+                    from tpudist.comm import measure_h2d_mbps
+
+                    tel.h2d_mbps = measure_h2d_mbps()
             breakdown = tel is not None and tel.config.breakdown
 
             # live HBM snapshot post-bring-up (params+opt state placed,
