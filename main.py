@@ -130,6 +130,28 @@ def parse_args(argv=None):
                         "from the step's H2D path; incompatible with "
                         "--augment (host-side) and --dataset imagenet "
                         "(streaming)")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="observability subsystem (tpudist.telemetry): "
+                        "in-step health metrics + non-finite update guard, "
+                        "NaN/divergence sentry with profiler flight "
+                        "recorder, step-time breakdown, MFU rows — a "
+                        "per-process JSONL stream next to the TSV "
+                        "(docs/OBSERVABILITY.md)")
+    parser.add_argument("--health", action="store_true",
+                        help="run-health layer on top of --telemetry "
+                        "(implied): cross-process straggler aggregation, "
+                        "in-graph replica-divergence probe, hang watchdog "
+                        "with crash forensics, and a {JobID}_report.json "
+                        "end-of-run report (docs/OBSERVABILITY.md §7, "
+                        "docs/MULTIHOST.md)")
+    parser.add_argument("--hang_timeout", default=300.0, type=float,
+                        help="with --health: seconds without a completed "
+                        "step before the watchdog dumps thread stacks and "
+                        "writes the crash report (keep it above the "
+                        "attach's compile time; 0 disables the watchdog)")
+    parser.add_argument("--divergence_every", default=200, type=int,
+                        help="with --health: steps between replica-"
+                        "checksum divergence probes (0 disables the probe)")
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
     parser.add_argument("--checkpoint_dir", default=None, type=str,
@@ -358,6 +380,14 @@ def main(argv=None):
         loss_fn = smoothed_cross_entropy(args.label_smoothing)
     else:
         from tpudist.train import cross_entropy_loss as loss_fn
+    telemetry = args.telemetry
+    if args.health:
+        from tpudist.telemetry.health import health_config
+
+        telemetry = health_config(
+            divergence_every=args.divergence_every,
+            hang_timeout_s=args.hang_timeout or None,
+        )
     state, losses = fit(
         model, tx, loader,
         epochs=args.epochs, mesh=mesh,
@@ -371,6 +401,7 @@ def main(argv=None):
         input_transform=input_transform,
         profile=not args.no_profiler,
         log_dir=args.log_dir,
+        telemetry=telemetry,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,

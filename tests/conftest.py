@@ -67,6 +67,29 @@ _SMOKE_FILES = {
 }
 
 
+def pytest_configure(config):
+    """Opt-in tier-1 marker audit (tools/marker_audit.py): with
+    ``TPUDIST_MARKER_AUDIT`` set, every executed test's call duration is
+    checked against the per-test budget and the session FAILS (exit 3)
+    if an over-budget test is missing the ``slow`` marker — the guard
+    that keeps the ``not slow`` suite inside its 870 s tier-1 window."""
+    if not os.environ.get("TPUDIST_MARKER_AUDIT"):
+        return
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tools = os.path.join(repo, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import marker_audit
+
+    # is_registered, not a name check: the same module may already be
+    # loaded under its own name via `-p marker_audit` or the CLI wrapper,
+    # and registering the object twice is a pytest startup error
+    if not config.pluginmanager.is_registered(marker_audit):
+        config.pluginmanager.register(marker_audit, "tpudist-marker-audit")
+
+
 def pytest_collection_modifyitems(config, items):
     """Tests marked ``subproc_only`` run ONLY inside their wrapper's child
     process (TPUDIST_SUBPROC_TEST=1) — the containment mechanism for the

@@ -278,6 +278,66 @@ def test_chunked_replicated_put_matches_and_chunks(monkeypatch):
     assert len(put_sizes) >= 4 * n_dev  # 8 rows / 2-row chunks per device
 
 
+def test_multiprocess_stage_routes_through_chunked_put(monkeypatch):
+    """ADVICE r5 closure, pinned: under a (simulated) multi-process world
+    the rotation's ``_stage`` must build the replicated shard via
+    ``_chunked_replicated_put`` — per-device assembly in chunk-bounded
+    slices — and never issue a single full-shard ``device_put`` (the
+    documented transport-hang guard that the old ``put_sharded`` route
+    bypassed)."""
+    import jax as jax_mod
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.data import device_cache as dc
+    from tpudist.data.device_cache import RotatingDeviceCache
+
+    mesh = mesh_lib.create_mesh()
+    n, row = 32, 4 * 4 * 3
+    data = {
+        "image": np.arange(n * row, dtype=np.uint8).reshape(n, 4, 4, 3),
+        "label": np.arange(n, dtype=np.int32),
+    }
+    rot = RotatingDeviceCache(data, 8, shard_rows=16, mesh=mesh,
+                              rank=0, num_replicas=2)
+
+    routed = []
+    real_crp = dc._chunked_replicated_put
+
+    def spying_crp(x, sharding):
+        routed.append(x.shape)
+        return real_crp(x, sharding)
+
+    put_sizes = []
+    real_put = jax_mod.device_put
+
+    def counting_put(x, *a, **k):
+        if hasattr(x, "nbytes"):
+            put_sizes.append(x.nbytes)
+        return real_put(x, *a, **k)
+
+    monkeypatch.setattr(dc, "_chunked_replicated_put", spying_crp)
+    monkeypatch.setattr(jax_mod, "device_put", counting_put)
+    # pretend this is a 2-process world (the branch under test) and
+    # tighten the chunk budget so a 16-row shard must split into >=4
+    # transfers per device instead of legitimately fitting one chunk
+    monkeypatch.setattr(dc.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(dc, "_CHUNK_BYTES", 4 * row)
+
+    shard_rows = np.arange(16)
+    cache, labels = rot._stage(shard_rows)
+
+    assert routed == [(16, 4, 4, 3)]  # the multi-process path WAS chunked
+    shard_bytes = data["image"][shard_rows].nbytes
+    n_dev = len(mesh.devices.flat)
+    # no transfer carried the full shard, every one respected the budget
+    assert put_sizes and max(put_sizes) < shard_bytes
+    assert max(put_sizes) <= 4 * row
+    assert len(put_sizes) >= 4 * n_dev
+    # and the assembled replicated value is exact
+    np.testing.assert_array_equal(np.asarray(cache), data["image"][shard_rows])
+    np.testing.assert_array_equal(labels, data["label"][shard_rows])
+
+
 def _tiny_chunk_put(dc, x, sharding):
     """_chunked_device_put's in-place assembly with a 2-row chunk budget —
     the same jitted init/write pair, just a tiny cap so an 8-row test

@@ -1,0 +1,428 @@
+"""Run-health unit tests (tpudist.telemetry.health + dp.make_divergence_probe):
+sink rotation segments, the crash-forensics tail buffer, thread-stack dumps,
+the hang watchdog's arm/trip/one-shot contract, the straggler fold rule, and
+the in-graph replica-divergence probe against a hand-desynced "replicated"
+array (the single-process form of the multi-process perturbation test in
+test_multiproc_health.py)."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import FrozenDict
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudist import mesh as mesh_lib
+from tpudist.telemetry import TelemetrySink
+from tpudist.telemetry import health as H
+
+
+# -- sink rotation ----------------------------------------------------------
+
+def test_sink_rotation_segments(tmp_path):
+    """Size-capped rotation: the base path stays the live tail, sealed
+    segments get increasing numbers, and the full row sequence survives
+    reassembly across the chain."""
+    path = tmp_path / "J_telemetry_0.jsonl"
+    with TelemetrySink(path, max_bytes=220) as sink:
+        for i in range(12):
+            sink.write("heartbeat", i, seqno=i)
+        segments = sink.segments()
+    assert segments[-1] == path  # active file last
+    assert len(segments) > 1  # the cap actually rotated
+    assert [p.name for p in segments[:-1]] == [
+        f"{path.name}.{n}" for n in range(1, len(segments))
+    ]
+    rows = [
+        json.loads(line)
+        for p in segments
+        for line in p.read_text().splitlines()
+    ]
+    # every row strict JSON, in order, none lost at the rotation seams
+    assert [r["seqno"] for r in rows] == list(range(12))
+    # each sealed segment respected the cap
+    for p in segments[:-1]:
+        assert p.stat().st_size <= 220
+
+
+def test_sink_rotation_numbering_survives_cleanup_gaps(tmp_path):
+    """Deleting an old segment mid-run (routine log cleanup) must not
+    make the NEWEST data inherit the OLDEST position: numbering is
+    monotonic, and segments() orders numerically across the gap."""
+    path = tmp_path / "J_telemetry_0.jsonl"
+    with TelemetrySink(path, max_bytes=220) as sink:
+        for i in range(8):
+            sink.write("heartbeat", i, seqno=i)
+        first = sink.segments()
+        assert len(first) >= 3
+        first[0].unlink()  # operator deletes the oldest sealed segment
+        for i in range(8, 16):
+            sink.write("heartbeat", i, seqno=i)
+        segs = sink.segments()
+    nums = [int(p.name.rsplit(".", 1)[1]) for p in segs[:-1]]
+    assert nums == sorted(nums)
+    assert first[0].name not in {p.name for p in segs}  # never reused
+    # the surviving chain still reads oldest→newest
+    seq = [json.loads(l)["seqno"] for p in segs for l in p.read_text().splitlines()]
+    assert seq == sorted(seq)
+
+
+def test_sink_rotation_cap_counts_utf8_bytes(tmp_path):
+    """The cap is bytes on disk: rows with non-ASCII content (a hostname,
+    an event string) must not under-count and overshoot the segment cap."""
+    path = tmp_path / "J_telemetry_0.jsonl"
+    with TelemetrySink(path, max_bytes=400) as sink:
+        for i in range(12):
+            sink.write("heartbeat", i, host="héllo-wörld-ø" * 3)
+        segs = sink.segments()
+    for p in segs[:-1]:
+        assert p.stat().st_size <= 400
+
+
+def test_sink_rotation_off_by_default(tmp_path):
+    path = tmp_path / "J_telemetry_0.jsonl"
+    with TelemetrySink(path) as sink:
+        for i in range(50):
+            sink.write("health", i)
+        assert sink.segments() == [path]
+    assert not list(tmp_path.glob("*.jsonl.*"))
+
+
+def test_sink_tail_ring_buffer(tmp_path):
+    with TelemetrySink(tmp_path / "t.jsonl") as sink:
+        for i in range(300):
+            sink.write("health", i)
+        tail = sink.tail(5)
+        assert [r["step"] for r in tail] == [295, 296, 297, 298, 299]
+        # the ring is bounded at TAIL_ROWS regardless of how much was written
+        assert len(sink.tail(10_000)) == TelemetrySink.TAIL_ROWS
+
+
+# -- thread stacks / watchdog ----------------------------------------------
+
+def test_thread_stacks_contains_caller():
+    stacks = H.thread_stacks()
+    assert any("MainThread" in k for k in stacks)
+    joined = "".join(s for frames in stacks.values() for s in frames)
+    assert "test_thread_stacks_contains_caller" in joined
+
+
+def test_watchdog_arms_on_first_beat_and_trips_once():
+    trips = []
+    wd = H.HangWatchdog(0.15, trips.append, poll_s=0.03)
+    try:
+        # not armed before the first beat: bring-up (attach + compile) can
+        # take arbitrarily long without tripping
+        time.sleep(0.4)
+        assert wd.tripped is None and not trips
+        wd.beat(5)
+        time.sleep(0.5)
+        assert wd.tripped is not None
+        assert wd.tripped["last_step"] == 5
+        assert wd.tripped["age_s"] >= 0.15
+        # one-shot: beating again never re-trips the finished monitor
+        wd.beat(6)
+        time.sleep(0.3)
+        assert len(trips) == 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_quiet_while_beats_flow():
+    trips = []
+    wd = H.HangWatchdog(0.3, trips.append, poll_s=0.03)
+    try:
+        for s in range(8):
+            wd.beat(s)
+            time.sleep(0.05)
+        assert wd.tripped is None and not trips
+    finally:
+        wd.stop()
+
+
+# -- straggler fold rule ----------------------------------------------------
+
+def _fake_two_host_aggregator(sink, **kw):
+    """An aggregator whose fold sees a fabricated 2-host / 8-device world
+    (this suite runs one process), exercising the rank-0 fold rule
+    without a multi-process launch."""
+    agg = H.CrossProcessAggregator(sink, **kw)
+    agg._slot_proc = np.asarray([0, 0, 0, 0, 1, 1, 1, 1])
+    agg._procs = [0, 1]
+    return agg
+
+
+def _rows(step, interval, host0, host1):
+    steps = np.full((8, 1), step, np.int32)
+    floats = np.zeros((8, 2), np.float32)
+    floats[:, 0] = interval
+    floats[:4, 1] = host0
+    floats[4:, 1] = host1
+    return steps, floats
+
+
+def test_straggler_fires_once_on_persistent_slow_rank(tmp_path):
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    agg = _fake_two_host_aggregator(sink, every=1, ratio=1.5, patience=3)
+    # rank 1 persistently burns 80% of each step host-side; rank 0 ~2%
+    for k in range(5):
+        agg._fold(*_rows(k + 1, 0.5, 0.01, 0.4), k + 1)
+    sink.close()
+    rows = [json.loads(l) for l in (tmp_path / "t.jsonl").read_text().splitlines()]
+    fleet = [r for r in rows if r["kind"] == "fleet"]
+    stragglers = [r for r in rows if r["kind"] == "straggler"]
+    assert len(fleet) == 5
+    assert fleet[0]["per_rank_host_s"] == {"0": 0.01, "1": 0.4}
+    # one-shot: fires at the patience-th consecutive fold, never again
+    assert len(stragglers) == 1
+    assert stragglers[0]["rank"] == 1
+    assert stragglers[0]["consecutive_folds"] == 3
+    assert agg.straggler_events and agg.straggler_events[0]["rank"] == 1
+    assert agg.last_seen == {0: 5, 1: 5}
+
+
+def test_straggler_silent_on_healthy_and_transient_fleets(tmp_path):
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    agg = _fake_two_host_aggregator(sink, every=1, ratio=1.5, patience=3)
+    # healthy: both ranks near-zero host share
+    for k in range(4):
+        agg._fold(*_rows(k + 1, 0.5, 0.01, 0.012), k + 1)
+    # transient: rank 1 spikes for patience-1 folds, then recovers — the
+    # streak resets and nothing fires
+    agg._fold(*_rows(5, 0.5, 0.01, 0.4), 5)
+    agg._fold(*_rows(6, 0.5, 0.01, 0.4), 6)
+    agg._fold(*_rows(7, 0.5, 0.01, 0.012), 7)
+    agg._fold(*_rows(8, 0.5, 0.01, 0.4), 8)
+    sink.close()
+    rows = [json.loads(l) for l in (tmp_path / "t.jsonl").read_text().splitlines()]
+    assert not [r for r in rows if r["kind"] == "straggler"]
+    assert not agg.straggler_events
+
+
+def test_aggregator_single_host_never_straggles(tmp_path):
+    """A one-host fleet writes fleet rows (the skew stats are still the
+    report's evidence) but has no one to straggle behind."""
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    agg = H.CrossProcessAggregator(sink, every=2, patience=1)
+    agg.on_step(2, 0.5, 0.45)  # dispatch
+    agg.on_step(4, 0.5, 0.45)  # resolves step 2, dispatches step 4
+    agg.flush()
+    sink.close()
+    rows = [json.loads(l) for l in (tmp_path / "t.jsonl").read_text().splitlines()]
+    assert [r["step"] for r in rows if r["kind"] == "fleet"] == [2, 4]
+    assert not [r for r in rows if r["kind"] == "straggler"]
+
+
+def test_aggregator_gather_rides_delayed_fetch(tmp_path):
+    """The in-graph gather's result is read one cadence later: after ONE
+    on_step nothing has folded yet (the value is still in flight on the
+    async pipeline); the next cadence folds it."""
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    agg = H.CrossProcessAggregator(sink, every=2)
+    agg.on_step(1, 0.5, 0.0)  # off-cadence: ignored entirely
+    agg.on_step(2, 0.5, 0.0)
+    assert agg.fleet is None and agg._pending is not None
+    agg.on_step(4, 0.7, 0.0)
+    assert agg.fleet is not None
+    assert agg.fleet["per_rank_interval_s"] == {"0": 0.5}
+    sink.close()
+
+
+# -- divergence probe -------------------------------------------------------
+
+def _replicated_state(mesh, extra_opt=()):
+    from tpudist.train import TrainState
+
+    repl = mesh_lib.replicated_sharding(mesh)
+    params = jax.device_put(
+        {"w": np.arange(64, dtype=np.float32), "b": np.ones(8, np.float32)},
+        repl,
+    )
+    return TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        batch_stats=FrozenDict(), opt_state=extra_opt,
+    )
+
+
+def test_divergence_probe_clean_then_desynced():
+    from tpudist.parallel.dp import make_divergence_probe
+
+    mesh = mesh_lib.create_mesh()
+    state = _replicated_state(mesh)
+    probe = make_divergence_probe(state, mesh)
+    clean = {k: int(v) for k, v in probe(state).items()}
+    assert clean["replica_divergence"] == 0
+    assert clean["state_nonfinite"] == 0
+
+    # hand-build a "replicated" param whose copy on one device has a
+    # single element perturbed — the silent-desync failure mode
+    repl = mesh_lib.replicated_sharding(mesh)
+    base = np.arange(64, dtype=np.float32)
+    bufs = []
+    for i, d in enumerate(mesh.devices.flat):
+        arr = base.copy()
+        if i == 3:
+            arr[17] += 1e-3
+        bufs.append(jax.device_put(arr, d))
+    bad = jax.make_array_from_single_device_arrays(base.shape, repl, bufs)
+    state_bad = state.replace(
+        params={"w": bad, "b": state.params["b"]}
+    )
+    desynced = {k: int(v) for k, v in probe(state_bad).items()}
+    assert desynced["replica_divergence"] == 1  # exactly the one bad replica
+    # the fleet checksum itself (replica 0's view) is unchanged — the
+    # signal is the cross-replica comparison, not the value
+    assert desynced["replica_checksum"] == clean["replica_checksum"]
+
+
+def test_divergence_probe_single_bit_flip_is_visible():
+    """The checksum is over raw BITS, so a low-mantissa flip a float sum
+    would bury in accumulation error still changes a replica's sum."""
+    from tpudist.parallel.dp import make_divergence_probe
+
+    mesh = mesh_lib.create_mesh()
+    state = _replicated_state(mesh)
+    probe = make_divergence_probe(state, mesh)
+    repl = mesh_lib.replicated_sharding(mesh)
+    base = np.arange(64, dtype=np.float32)
+    bufs = []
+    for i, d in enumerate(mesh.devices.flat):
+        arr = base.copy()
+        if i == 5:
+            u = arr.view(np.uint32)
+            u[30] ^= 1  # lowest mantissa bit
+        bufs.append(jax.device_put(arr, d))
+    bad = jax.make_array_from_single_device_arrays(base.shape, repl, bufs)
+    out = probe(state.replace(params={"w": bad, "b": state.params["b"]}))
+    assert int(out["replica_divergence"]) == 1
+
+
+def test_divergence_probe_zero1_sharded_state():
+    """ZeRO-1-style [world, cols] P(data) opt leaves hold a different
+    shard per replica — no redundancy to compare, so they contribute the
+    psum'd checksum and the non-finite corruption signal instead of
+    false replica-divergence positives."""
+    from tpudist.parallel.dp import make_divergence_probe
+
+    mesh = mesh_lib.create_mesh()
+    sh = NamedSharding(mesh, P("data"))
+    opt = np.arange(32, dtype=np.float32).reshape(8, 4)
+    leaf = jax.device_put(opt, sh)
+    state = _replicated_state(mesh, extra_opt=(leaf,))
+    probe = make_divergence_probe(state, mesh)
+    clean = {k: int(v) for k, v in probe(state).items()}
+    assert clean["replica_divergence"] == 0
+    assert clean["state_nonfinite"] == 0
+    assert clean["sharded_checksum"] != 0
+
+    opt_bad = opt.copy()
+    opt_bad[2, 1] = np.nan  # corruption inside one replica's shard
+    state_bad = state.replace(opt_state=(jax.device_put(opt_bad, sh),))
+    bad = {k: int(v) for k, v in probe(state_bad).items()}
+    assert bad["replica_divergence"] == 0
+    assert bad["state_nonfinite"] == 1
+    assert bad["sharded_checksum"] != clean["sharded_checksum"]
+
+
+def test_divergence_probe_crosses_non_data_axes():
+    """A desync in a tensor column OTHER than 0 must surface in the
+    fetched scalar: the per-column verdicts are psum'd across the
+    non-data axes, so out_specs=P() is true rather than asserted (the
+    regression where device 0's column silently spoke for the fleet)."""
+    from tpudist.parallel.dp import make_divergence_probe
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=4, tensor=2))
+    repl = mesh_lib.replicated_sharding(mesh)
+    base = np.arange(64, dtype=np.float32)
+    from tpudist.train import TrainState
+
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params={"w": jax.device_put(base, repl)},
+        batch_stats=FrozenDict(), opt_state=(),
+    )
+    probe = make_divergence_probe(state, mesh)
+    assert int(probe(state)["replica_divergence"]) == 0
+
+    devs = list(mesh.devices.flat)
+    # flat index 3 = (data=1, tensor=1): a non-zero coordinate on BOTH
+    # the compared axis and a crossed one
+    bufs = []
+    for i, d in enumerate(devs):
+        arr = base.copy()
+        if i == 3:
+            arr[7] += 1e-3
+        bufs.append(jax.device_put(arr, d))
+    bad = jax.make_array_from_single_device_arrays(base.shape, repl, bufs)
+    out = probe(state.replace(params={"w": bad}))
+    assert int(out["replica_divergence"]) == 1
+
+    # a FULLY desynced replica (every tensor column corrupted — the
+    # resumed-from-wrong-step failure) counts as ONE bad replica, not
+    # once per column: the cross-axis fold is a max, so the operator's
+    # triage number stays a replica count
+    bufs = []
+    for i, d in enumerate(devs):
+        arr = base.copy()
+        if i in (2, 3):  # data=1: both its tensor-column devices
+            arr += 1e-3
+        bufs.append(jax.device_put(arr, d))
+    bad_full = jax.make_array_from_single_device_arrays(
+        base.shape, repl, bufs
+    )
+    out = probe(state.replace(params={"w": bad_full}))
+    assert int(out["replica_divergence"]) == 1
+
+
+def test_divergence_probe_none_on_single_replica():
+    from tpudist.parallel.dp import make_divergence_probe
+
+    mesh = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(data=1, tensor=-1)
+    )
+    state = _replicated_state(mesh_lib.create_mesh())
+    assert make_divergence_probe(state, mesh) is None
+
+
+# -- report helpers ---------------------------------------------------------
+
+def test_report_with_nan_anomaly_stays_strict_json(tmp_path):
+    """The run that died of a NaN loss records that NaN in its sentry
+    events; the report/crash writers must serialize it as null (the
+    sink's strict-JSON contract), not a bare NaN token that breaks every
+    strict consumer of exactly the forensics written for them."""
+    from tpudist.telemetry import NanSentry, TelemetryConfig, TelemetrySink
+
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    cfg = TelemetryConfig(hang_timeout_s=None)
+    rh = H.RunHealth(cfg, sink, job_id="NJ", log_dir=str(tmp_path))
+
+    class _TelStub:
+        sentry = NanSentry(min_steps=2)
+        _comm = None
+
+    _TelStub.sentry.observe(3, float("nan"))
+    assert _TelStub.sentry.events and _TelStub.sentry.events[0]["loss"] != \
+        _TelStub.sentry.events[0]["loss"]  # really a NaN in the history
+    rh._tel = _TelStub()
+    rh.observe_interval(3, 0.1)
+    rh.finish(status="crashed:FloatingPointError")
+    text = (tmp_path / "NJ_report.json").read_text()
+    report = json.loads(text)  # strict parse
+    assert "NaN" not in text
+    assert report["anomaly_events"][0]["loss"] is None
+    sink.close()
+
+
+def test_percentiles_and_bounded_observation():
+    p = H._percentiles(list(range(1, 101)))
+    assert p["p50"] == pytest.approx(50.5)
+    assert p["max"] == 100 and p["n"] == 100
+    assert H._percentiles([]) is None
+    xs = []
+    for i in range(1000):
+        H._observe_bounded(xs, float(i), cap=100)
+    assert len(xs) <= 100  # multi-day runs stay bounded

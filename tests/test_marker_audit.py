@@ -1,0 +1,81 @@
+"""The tier-1 marker audit (tools/marker_audit.py): the offenders rule on
+synthetic records, and the plugin end-to-end in a child pytest run — an
+over-budget test without the ``slow`` marker fails the session (exit 3)
+and is named; marking it ``slow`` passes the audit. Keeps the ``not
+slow`` suite honest against the 870 s tier-1 window."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import marker_audit  # noqa: E402
+
+
+def test_offenders_rule():
+    records = [
+        ("tests/a.py::test_fast", 0.5, False),
+        ("tests/a.py::test_big_unmarked", 45.0, False),
+        ("tests/a.py::test_bigger_unmarked", 90.0, False),
+        ("tests/b.py::test_big_marked", 500.0, True),  # slow: exempt
+    ]
+    bad = marker_audit.offenders(records, budget=30.0)
+    # slowest first, marked tests exempt however long they run
+    assert bad == [
+        ("tests/a.py::test_bigger_unmarked", 90.0),
+        ("tests/a.py::test_big_unmarked", 45.0),
+    ]
+    assert marker_audit.offenders(records, budget=1000.0) == []
+
+
+def _run_child_pytest(tmp_path, test_src, budget="0.2"):
+    d = tmp_path / "suite"
+    d.mkdir()
+    (d / "test_child.py").write_text(test_src)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(REPO, "tools")
+    env["TPUDIST_MARKER_BUDGET_S"] = budget
+    env.pop("TPUDIST_MARKER_AUDIT", None)  # plugin loads via -p, not env
+    env.pop("PYTEST_CURRENT_TEST", None)
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", str(d), "-q", "-p", "marker_audit",
+         "-p", "no:cacheprovider"],
+        env=env, cwd=str(d), capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_plugin_fails_unmarked_over_budget_test(tmp_path):
+    r = _run_child_pytest(tmp_path, textwrap.dedent("""
+        import time
+
+        def test_quick():
+            pass
+
+        def test_creeping():
+            time.sleep(0.5)
+    """))
+    assert r.returncode == marker_audit.EXIT_OFFENDERS, r.stdout + r.stderr
+    assert "marker audit FAILED" in r.stdout
+    assert "test_creeping" in r.stdout
+    # the fast test is not named as an offender
+    offenders_block = r.stdout.split("marker audit FAILED")[1]
+    assert "test_quick" not in offenders_block
+
+
+def test_plugin_passes_marked_slow_test(tmp_path):
+    r = _run_child_pytest(tmp_path, textwrap.dedent("""
+        import time
+        import pytest
+
+        @pytest.mark.slow
+        def test_known_slow():
+            time.sleep(0.5)
+
+        def test_quick():
+            pass
+    """))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "within the" in r.stdout  # the all-clear summary line

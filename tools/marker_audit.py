@@ -1,0 +1,110 @@
+"""Tier-1 marker audit: keep the `not slow` suite inside its time window.
+
+The tier-1 gate runs ``pytest -m 'not slow'`` under a hard 870 s budget
+(ROADMAP.md). That window only holds if every test that got expensive —
+usually by growing a subprocess world or a fat compile — carries the
+``slow`` marker. Nothing enforced that until now: a test could creep past
+a minute and silently eat the whole suite's headroom until the next
+timeout-driven archaeology session.
+
+This module is a pytest plugin (plus a CLI wrapper) that records every
+executed test's call duration and, at session end, FAILS the run (exit
+status 3) listing any test that exceeded the per-test budget without the
+``slow`` marker. Budget: ``TPUDIST_MARKER_BUDGET_S`` (seconds, default
+30 — generous against the measured suite, where the slowest properly
+tier-1 tests sit in the low-20s cold).
+
+Three ways to run it:
+
+- ``python tools/marker_audit.py`` — runs the tier-1 selection
+  (``tests/ -m 'not slow'``) with the audit armed; extra args pass
+  through to pytest.
+- ``TPUDIST_MARKER_AUDIT=1 python -m pytest tests/ -m 'not slow'`` —
+  tests/conftest.py registers the plugin when the env var is set, so the
+  audit can ride any existing invocation.
+- ``python -m pytest <dir> -p marker_audit`` with this directory on
+  ``PYTHONPATH`` — what the audit's own integration test does.
+
+Pure logic lives in :func:`offenders` so it is unit-testable without a
+pytest session.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+DEFAULT_BUDGET_S = 30.0
+EXIT_OFFENDERS = 3
+
+_records: list[tuple[str, float, bool]] = []
+
+
+def budget_s() -> float:
+    return float(os.environ.get("TPUDIST_MARKER_BUDGET_S", DEFAULT_BUDGET_S))
+
+
+def offenders(records, budget: float) -> list[tuple[str, float]]:
+    """``(nodeid, seconds)`` for every recorded test over ``budget`` that
+    is NOT marked ``slow``, slowest first. Marked tests may take as long
+    as they like — they are deselected from tier-1 by construction."""
+    bad = [
+        (nodeid, duration)
+        for nodeid, duration, is_slow in records
+        if duration > budget and not is_slow
+    ]
+    return sorted(bad, key=lambda r: -r[1])
+
+
+# -- pytest plugin hooks ----------------------------------------------------
+
+def pytest_runtest_logreport(report):
+    if report.when != "call":
+        return
+    _records.append((
+        report.nodeid,
+        float(getattr(report, "duration", 0.0)),
+        "slow" in getattr(report, "keywords", {}),
+    ))
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    bad = offenders(_records, budget_s())
+    if not bad:
+        terminalreporter.write_line(
+            f"marker audit: all {len(_records)} tests within the "
+            f"{budget_s():.0f}s per-test budget or marked slow"
+        )
+        return
+    terminalreporter.write_line(
+        f"marker audit FAILED: {len(bad)} test(s) exceeded the "
+        f"{budget_s():.0f}s per-test budget without the 'slow' marker "
+        "(tier-1 window erosion — mark them slow or make them cheap):",
+    )
+    for nodeid, duration in bad:
+        terminalreporter.write_line(f"  {duration:8.1f}s  {nodeid}")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if offenders(_records, budget_s()):
+        session.exitstatus = EXIT_OFFENDERS
+
+
+# -- CLI --------------------------------------------------------------------
+
+DEFAULT_ARGS = ["tests/", "-q", "-m", "not slow", "-p", "no:cacheprovider"]
+
+
+def main(argv=None) -> int:
+    import pytest
+
+    # extra args APPEND to the tier-1 selection (they are pass-through
+    # flags like -x or -k) — replacing it would silently audit a
+    # different suite than the one the budget protects; a later -m from
+    # the user still wins per pytest's last-one-wins flag handling
+    args = DEFAULT_ARGS + list(sys.argv[1:] if argv is None else argv)
+    return pytest.main(args, plugins=[sys.modules[__name__]])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

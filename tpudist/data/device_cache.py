@@ -44,6 +44,13 @@ from tpudist.data.sampler import DistributedSampler
 
 logger = logging.getLogger(__name__)
 
+# the transport-hang guard's slice budget: a single hundreds-of-MB
+# device_put has been observed to hang a remote-attach transport outright
+# (docs/PERF.md §3b), so every staging path bounds its transfers to this
+# many bytes. Module-level so the regression tests can tighten it and
+# prove the multi-process rotation path (ADVICE r5) really chunks.
+_CHUNK_BYTES = 64 * 1024 * 1024
+
 
 def _chunked_device_put(
     images: np.ndarray, sharding, *, in_place: bool = False
@@ -66,7 +73,7 @@ def _chunked_device_put(
       compiled programs have already run — the link is whatever it is —
       and shard-sized HBM headroom is the scarce resource."""
     row_bytes = max(images[:1].nbytes, 1)
-    rows_per_chunk = max(64 * 1024 * 1024 // row_bytes, 1)
+    rows_per_chunk = max(_CHUNK_BYTES // row_bytes, 1)
     n = images.shape[0]
     if n <= rows_per_chunk:
         return jax.device_put(images, sharding)

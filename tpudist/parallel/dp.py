@@ -378,6 +378,170 @@ class GradReducer:
         return best * self.reductions_per_step(grad_accum)
 
 
+_UINT_OF_SIZE = {1: "uint8", 2: "uint16", 4: "uint32"}
+
+
+def _bit_checksum(x) -> jax.Array:
+    """Order-independent uint32 wraparound sum of a block's raw BITS —
+    exact, so a single flipped bit anywhere in the block changes the value
+    (a float sum would hide a low-mantissa flip in a 100M-element tree
+    under fp32 accumulation error). Modular uint32 arithmetic keeps the
+    reduction deterministic and cheap; bool widens to uint8, 8-byte leaves
+    bitcast to a trailing pair of uint32 words."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    size = jnp.dtype(x.dtype).itemsize
+    if size == 8:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    else:
+        u = jax.lax.bitcast_convert_type(
+            x, jnp.dtype(_UINT_OF_SIZE[size])
+        )
+    return jnp.sum(u.astype(jnp.uint32), dtype=jnp.uint32)
+
+
+def make_divergence_probe(state, mesh: Mesh):
+    """Compiled replica-divergence probe over the ``data`` axis — the
+    in-graph detector for the silent multi-host failure mode where
+    "data-parallel" replicas desync (missed collective, bit corruption,
+    a host restarting from the wrong step) and the job quietly trains W
+    different models (tpudist.telemetry.health drives it at a cadence).
+
+    Built from a placed ``state`` (a :class:`~tpudist.train.TrainState`,
+    or any pytree of mesh-placed arrays); the probe keys off each leaf's
+    ACTUAL sharding, so it composes with every reduction path — implicit
+    XLA psum, the explicit ``GradReducer`` shard_map (whose per-replica
+    dropout/quantization must still produce bit-identical replicated
+    params), and ZeRO-1 ``shard_opt_state``:
+
+    - leaves whose spec does NOT touch ``data``/``fsdp`` (params, BN
+      stats, replicated opt leaves — possibly TP-sharded over other axes)
+      are REPLICATED across data replicas by contract: each replica's
+      local copy is bit-checksummed and all-gathered over ``data``
+      within its mesh column, and the WORST column's verdict is pmax'd
+      across the remaining axes — ``replica_divergence`` counts replicas
+      disagreeing with replica 0 (a desync in a TP column other than 0
+      still surfaces in the fetched scalar; a fully-desynced replica
+      counts once, not once per column — max, not sum, so the count
+      stays a replica count). Any single-bit desync is visible within
+      one probe; desyncs confined to DIFFERENT columns may under-count
+      but never read zero.
+    - leaves sharded over ``data``/``fsdp`` (ZeRO-1's ``[world, ...]``
+      Adam mirrors) hold a DIFFERENT shard per replica — no redundancy to
+      compare, so they contribute an all-axes-psum'd global checksum
+      (``sharded_checksum``, drift-over-restarts evidence for the crash
+      report) and an all-axes-psum'd non-finite element count folded into
+      ``state_nonfinite`` (plus the worst device's replicated-leaf
+      count), the realistic corruption signal for unreplicated state —
+      counted no matter which mesh coordinate holds the poisoned shard.
+
+    Returns ``None`` when the mesh has one ``data`` replica (nothing to
+    compare), else a jitted ``probe(state) -> {"replica_divergence",
+    "replica_checksum", "sharded_checksum", "state_nonfinite"}`` whose
+    scalars ride ``copy_to_host_async`` like the step metrics. Cost: one
+    bandwidth-bound read of the state plus scalar collectives — the bench
+    leg ``gpt2_124m_health_overhead_pct`` holds probe+aggregation under
+    1% of step time at its cadence.
+    """
+    if int(mesh.shape[DATA_AXIS]) <= 1:
+        return None
+
+    def _tree(s):
+        if hasattr(s, "params"):
+            return (s.params, getattr(s, "batch_stats", ()), s.opt_state)
+        return s
+
+    leaves = jax.tree_util.tree_leaves(_tree(state))
+    rep_idx, sh_idx, rep_specs, sh_specs = [], [], [], []
+    for i, x in enumerate(leaves):
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        if spec is None:
+            continue  # host scalars / unplaced leaves: nothing to probe
+        names: set = set()
+        for part in spec:
+            names.update(part if isinstance(part, tuple) else (part,))
+        names.discard(None)
+        if names & {DATA_AXIS, FSDP_AXIS}:
+            sh_idx.append(i)
+            sh_specs.append(spec)
+        else:
+            rep_idx.append(i)
+            rep_specs.append(spec)
+
+    all_axes = tuple(mesh.axis_names)
+    other_axes = tuple(n for n in all_axes if n != DATA_AXIS)
+
+    def local(rep, sharded):
+        cks = jnp.uint32(0)
+        nonfin = jnp.int32(0)
+        for x in rep:
+            cks = cks + _bit_checksum(x)
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                nonfin = nonfin + jnp.sum(
+                    ~jnp.isfinite(x), dtype=jnp.int32
+                )
+        # the cross-replica comparison happens WITHIN each data column
+        # (devices sharing the other axes' coordinates hold the same
+        # logical block); the WORST column's verdict is then pmax'd
+        # across the remaining axes so every device — including the one
+        # the fetched scalar comes from — reports fleet-wide detection
+        # (out_specs=P() must be true, not asserted). Max, not sum: a
+        # fully-desynced replica corrupts every TP column and must count
+        # as ONE bad replica, not tensor-size of them (a sum would tell
+        # the operator 8 replicas diverged on an 8-way-TP mesh when one
+        # did); independent desyncs confined to different columns may
+        # under-count, but never read zero.
+        gathered = jax.lax.all_gather(cks, DATA_AXIS)
+        column = jnp.sum((gathered != gathered[0]).astype(jnp.int32))
+        diverged = (
+            jax.lax.pmax(column, other_axes) if other_axes else column
+        )
+        # replica 0's checksum (uniform along data even when a replica
+        # diverged), fleet-summed over the other axes — drift evidence
+        rep_cks = (
+            jax.lax.psum(gathered[0], other_axes)
+            if other_axes else gathered[0]
+        )
+        scks = jnp.uint32(0)
+        snf = jnp.int32(0)
+        for x in sharded:
+            scks = scks + _bit_checksum(x)
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                snf = snf + jnp.sum(~jnp.isfinite(x), dtype=jnp.int32)
+        # sharded-group sums cover EVERY axis: a ZeRO-1/fsdp shard's NaN
+        # must surface no matter which mesh coordinate holds it (a leaf
+        # replicated along some axis gets counted once per holding device
+        # — over-reporting, never missing)
+        scks = jax.lax.psum(scks, all_axes)
+        snf = jax.lax.psum(snf, all_axes)
+        # replicated-leaf non-finites: the worst device's count (replicas
+        # hold copies, so a sum would inflate world-fold; max is uniform
+        # and exact on a healthy fleet)
+        nonfin = jax.lax.pmax(nonfin, all_axes)
+        return {
+            "replica_divergence": diverged,
+            "replica_checksum": rep_cks,
+            "sharded_checksum": scks,
+            "state_nonfinite": nonfin + snf,
+        }
+
+    fn = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(tuple(rep_specs), tuple(sh_specs)),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+    def probe(state):
+        leaves = jax.tree_util.tree_leaves(_tree(state))
+        return fn(
+            tuple(leaves[i] for i in rep_idx),
+            tuple(leaves[i] for i in sh_idx),
+        )
+
+    return probe
+
+
 def make_reducer(
     reduce: "str | GradReducer",
     mesh: Mesh,

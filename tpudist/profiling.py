@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 from pathlib import Path
 
 import jax
@@ -59,6 +60,11 @@ class WindowedProfiler:
         self._cycle = 0
         self._tracing = False
         self._armed = 0  # remaining steps of an on-demand (arm()) window
+        # serializes the state machine against flush_armed(), which the
+        # hang watchdog calls from ITS thread: without it, a stall that
+        # resolves mid-flush lets the resumed main thread's step() race
+        # the teardown into a second stop_trace (which raises)
+        self._mutex = threading.Lock()
 
     def __enter__(self):
         # wait+warmup == 0 means "capture from the first step" — the window
@@ -103,31 +109,52 @@ class WindowedProfiler:
         anomaly event itself."""
         if not self.enabled or active_steps <= 0:
             return False
-        if self._tracing:
+        with self._mutex:
+            # same mutex as step()/flush_armed(): an arm racing the
+            # watchdog thread's flush must either land before the close
+            # (and be flushed with it) or open a fresh window after it —
+            # never overlap a start with an in-flight stop, and never
+            # report "already tracing" about a window being torn down
+            if self._tracing:
+                return True
+            self._armed = active_steps
+            self._start()
             return True
-        self._armed = active_steps
-        self._start()
-        return True
 
     def step(self) -> None:
         """Advance the schedule; call once per training iteration
         (the ``p.step()`` of /root/reference/main.py:115)."""
-        if self._armed:
-            # an armed window counts its own steps and leaves the scheduled
-            # state machine (cycle/step counters) exactly where it froze
-            self._armed -= 1
-            if self._armed <= 0 and self._tracing:
-                self._close_armed()
-            return
-        if not self.enabled or self._cycle >= self.repeat:
-            return
-        self._step += 1
-        if self._tracing and self._step >= self.skip + self.active:
-            self._stop()
-            if self._cycle < self.repeat and self.skip == 0:
+        with self._mutex:
+            if self._armed:
+                # an armed window counts its own steps and leaves the
+                # scheduled state machine (cycle/step counters) exactly
+                # where it froze
+                self._armed -= 1
+                if self._armed <= 0 and self._tracing:
+                    self._close_armed()
+                return
+            if not self.enabled or self._cycle >= self.repeat:
+                return
+            self._step += 1
+            if self._tracing and self._step >= self.skip + self.active:
+                self._stop()
+                if self._cycle < self.repeat and self.skip == 0:
+                    self._start()
+            elif not self._tracing and self._step == self.skip:
                 self._start()
-        elif not self._tracing and self._step == self.skip:
-            self._start()
+
+    def flush_armed(self) -> None:
+        """Close a currently-armed on-demand window NOW, flushing its
+        trace to disk — the hang watchdog's crash path
+        (tpudist.telemetry.health): a hung job's armed anomaly window
+        would otherwise die unwritten with the process. Scheduled windows
+        are left alone (their cycle accounting belongs to the main
+        thread); no-op when nothing is armed. Safe from the watchdog
+        thread: the mutex makes the close atomic against a resumed main
+        thread's step()."""
+        with self._mutex:
+            if self._tracing and self._armed:
+                self._close_armed()
 
     def _close_armed(self) -> None:
         # the armed-window teardown, shared by step()'s countdown and
@@ -148,10 +175,11 @@ class WindowedProfiler:
         logger.info("profiler trace written to %s", self.log_dir)
 
     def __exit__(self, *exc):
-        if self._tracing:
-            if self._armed:
-                # a run ending mid-anomaly-capture must not consume a
-                # scheduled repeat that never ran
-                self._close_armed()
-            else:
-                self._stop()
+        with self._mutex:
+            if self._tracing:
+                if self._armed:
+                    # a run ending mid-anomaly-capture must not consume a
+                    # scheduled repeat that never ran
+                    self._close_armed()
+                else:
+                    self._stop()

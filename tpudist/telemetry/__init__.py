@@ -41,6 +41,7 @@ import dataclasses
 import json
 import math
 import numbers
+import threading
 import time
 from pathlib import Path
 from typing import Any, Mapping
@@ -79,6 +80,19 @@ class TelemetryConfig:
     same off-switch contract as ``fit``'s ``memory_log_every``).
     ``jsonl_dir`` overrides where the sink writes (``None`` → fit's
     ``log_dir``).
+
+    The run-health fields (:mod:`tpudist.telemetry.health`) default OFF so
+    the JSONL/TSV streams stay byte-identical unless asked for:
+    ``aggregate_every`` (steps between cross-process folds; 0 = off) with
+    ``straggler_ratio``/``straggler_patience`` tuning the one-shot
+    straggler rule; ``divergence_every`` (steps between replica-checksum
+    probes; 0 = off); ``hang_timeout_s`` (step deadline for the watchdog;
+    ``None`` = off). ``run_report`` (on) writes ``{job}_report.json`` at
+    run end / crash — a separate file, never a stream row.
+    ``jsonl_max_bytes`` caps each JSONL segment before rotation
+    (``None`` = one unbounded file, the pre-rotation contract);
+    :func:`tpudist.telemetry.health.health_config` is the one-call
+    production preset (``main.py --health``).
     """
 
     health_metrics: bool = True
@@ -95,6 +109,14 @@ class TelemetryConfig:
     peak_flops: float | None = None
     heartbeat_every: int | None = None
     jsonl_dir: str | None = None
+    # run-health layer (tpudist.telemetry.health) — off by default
+    aggregate_every: int = 0
+    straggler_ratio: float = 1.5
+    straggler_patience: int = 3
+    divergence_every: int = 0
+    hang_timeout_s: float | None = None
+    run_report: bool = True
+    jsonl_max_bytes: int | None = None
 
     def step_kwargs(self) -> dict:
         """The ``make_train_step`` knobs this config implies — the ONE
@@ -109,7 +131,12 @@ class TelemetryConfig:
 def _json_safe(v):
     """JSONL rows must stay strict-JSON parseable: non-finite floats become
     null (a ``NaN`` literal breaks downstream ``json.loads``), numpy
-    scalars become python numbers."""
+    scalars become python numbers, containers (the run-health fleet row's
+    per-rank maps) recurse element-wise."""
+    if isinstance(v, Mapping):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
     if isinstance(v, bool) or v is None or isinstance(v, (str, int)):
         return v
     try:
@@ -137,14 +164,42 @@ class TelemetrySink:
     APPEND mode — both halves of the flight-recorder contract: the anomaly
     row must survive the crash it describes, including a checkpoint-resume
     of the same job_id truncating the evidence before anyone read it.
-    Attempts are separable by the ``t`` timestamps."""
+    Attempts are separable by the ``t`` timestamps.
 
-    def __init__(self, path: str | Path, *, rank: int = 0, clock=time.time):
+    ``max_bytes`` caps the ACTIVE file's size: when the next row would
+    exceed it, the file rotates to the next numbered segment
+    (``X.jsonl`` → ``X.jsonl.1``, ``.2``, …; the base path is always the
+    live tail) so a multi-day run never grows one unbounded file.
+    :meth:`segments` lists the segment chain oldest→active (the run
+    report records it); ``None`` (default) keeps the single-file
+    contract byte-identical. Writes are serialized by a lock (the hang
+    watchdog writes its ``watchdog`` row from the monitor thread while
+    the main thread may be mid-row), and the last 256 rows are kept in a
+    host ring buffer (:meth:`tail`) — the crash report's "what was the
+    run doing" evidence, readable even when the filesystem is the thing
+    that hung."""
+
+    TAIL_ROWS = 256
+
+    def __init__(self, path: str | Path, *, rank: int = 0, clock=time.time,
+                 max_bytes: int | None = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.rank = rank
         self._clock = clock
-        self._file = open(self.path, "a")
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._tail: collections.deque = collections.deque(
+            maxlen=self.TAIL_ROWS
+        )
+        self._size = self.path.stat().st_size if self.path.exists() else 0
+        # monotonic: max existing + 1, never the first free gap — an
+        # operator deleting old segments mid-run must not make the NEWEST
+        # data inherit the OLDEST position in the chain
+        self._next_segment = 1 + max(
+            (n for _, n in self._numbered_segments()), default=0
+        )
+        self._file = open(self.path, "a", encoding="utf-8")
 
     def write(self, kind: str, step: int | None = None, **fields) -> dict:
         row: dict[str, Any] = {
@@ -156,9 +211,73 @@ class TelemetrySink:
         if step is not None:
             row["step"] = int(step)
         row.update({k: _json_safe(v) for k, v in fields.items()})
-        self._file.write(json.dumps(row) + "\n")
-        self._file.flush()
+        line = json.dumps(row) + "\n"
+        # the cap is in BYTES on disk: a non-ASCII hostname or event
+        # string is longer in UTF-8 than in characters, and len(line)
+        # would under-count every such row until the segment overshoots
+        nbytes = len(line.encode("utf-8"))
+        with self._lock:
+            if (self.max_bytes and self._size
+                    and self._size + nbytes > self.max_bytes):
+                self._rotate()
+            self._file.write(line)
+            self._file.flush()
+            self._size += nbytes
+            self._tail.append(row)
         return row
+
+    def _numbered_segments(self) -> list[tuple[Path, int]]:
+        out = []
+        for p in self.path.parent.glob(f"{self.path.name}.*"):
+            try:
+                out.append((p, int(p.name[len(self.path.name) + 1:])))
+            except ValueError:
+                continue  # foreign suffix, not a segment
+        return sorted(out, key=lambda t: t[1])
+
+    def _rotate(self) -> None:
+        # called under the lock; the active file is full — seal it as the
+        # next numbered segment and start a fresh active file. Renaming
+        # the SEALED file (not the active one) keeps the base path stable
+        # for tailing dashboards across rotations.
+        self._file.close()
+        self.path.rename(
+            self.path.with_name(f"{self.path.name}.{self._next_segment}")
+        )
+        self._next_segment += 1
+        self._file = open(self.path, "a", encoding="utf-8")
+        self._size = 0
+
+    def segments(self) -> list[Path]:
+        """Existing segment files oldest→newest (numeric order, tolerant
+        of cleanup gaps), the active file last — what the run report
+        records so a reader can reassemble the full stream after
+        rotation."""
+        sealed = [p for p, _ in self._numbered_segments()]
+        return sealed + ([self.path] if self.path.exists() else [])
+
+    def tail(self, n: int = TAIL_ROWS, *,
+             lock_timeout: float | None = None) -> list[dict]:
+        """The most recent rows (host ring buffer) — crash forensics.
+
+        ``lock_timeout`` bounds the wait for the write lock: the hang
+        watchdog reads the tail while the main thread may be wedged
+        INSIDE ``write`` (a hung filesystem) holding the lock forever.
+        On timeout the deque is read lockless — appends are atomic, and
+        the rare concurrent-mutation ``RuntimeError`` degrades to an
+        empty tail rather than a deadlocked crash handler."""
+        acquired = self._lock.acquire(
+            timeout=-1 if lock_timeout is None else lock_timeout
+        )
+        try:
+            try:
+                rows = list(self._tail)
+            except RuntimeError:
+                rows = []
+        finally:
+            if acquired:
+                self._lock.release()
+        return rows[-n:]
 
     def close(self) -> None:
         if not self._file.closed:
@@ -324,6 +443,23 @@ class Telemetry:
         self._batch_bytes: int | None = None
         self._link_warned = False
         self._link_checks = 0
+        # run-health layer (tpudist.telemetry.health.RunHealth), attached
+        # by build_telemetry when any health knob (or the run report) is
+        # on; None keeps every health path a no-op
+        self.health = None
+        # heartbeat identity fields: process_index + hostname + a
+        # monotonic clock let the cross-process aggregator (and humans)
+        # align per-rank timelines — rank alone is ambiguous once
+        # global_rank counts replicas instead of hosts
+        import socket
+
+        self._host = socket.gethostname()
+        try:
+            import jax as _jax
+
+            self.process_index = int(_jax.process_index())
+        except Exception:
+            self.process_index = int(rank)
 
     # -- wiring ------------------------------------------------------------
 
@@ -396,6 +532,7 @@ class Telemetry:
         nonfinite = int(metrics.get("nonfinite_grad_count", 0) or 0)
         skipped = int(metrics.get("update_skipped", 0) or 0)
         cadence = step % self.log_every == 0
+        mfu_val = None
 
         if self.rank == 0 and cadence:
             health = {
@@ -437,14 +574,15 @@ class Telemetry:
                     **extra,
                 )
             if self._flops_per_step is not None and interval_s > 0:
+                # 8 decimals: a tiny CPU-test model's true MFU is ~1e-8
+                # and must not round to a fake 0.0
+                mfu_val = round(flops.mfu(
+                    self._flops_per_step, interval_s,
+                    peak=self.peak_flops, n_chips=self.n_chips,
+                ), 8)
                 self.sink.write(
                     "mfu", step,
-                    # 8 decimals: a tiny CPU-test model's true MFU is ~1e-8
-                    # and must not round to a fake 0.0
-                    mfu=round(flops.mfu(
-                        self._flops_per_step, interval_s,
-                        peak=self.peak_flops, n_chips=self.n_chips,
-                    ), 8),
+                    mfu=mfu_val,
                     flops_per_step=self._flops_per_step,
                     step_time_s=round(interval_s, 6),
                     tokens_per_sec=(
@@ -509,27 +647,92 @@ class Telemetry:
 
         if self.heartbeat_every and step % self.heartbeat_every == 0:
             # every process writes its own heartbeat — the cross-host
-            # straggler signal
+            # straggler signal. Existing fields stay byte-identical; the
+            # identity/clock triple (process_index, host, mono) is
+            # appended so per-rank timelines can be aligned (wall clocks
+            # skew across hosts; time.monotonic deltas do not)
             self.sink.write("heartbeat", step, epoch=epoch,
-                            interval_s=round(interval_s, 6))
+                            interval_s=round(interval_s, 6),
+                            process_index=self.process_index,
+                            host=self._host,
+                            mono=round(time.monotonic(), 6))
+
+        if self.health is not None:
+            # host_s is the rank-LOCAL share of the step (input wait +
+            # dispatch) — the scalar that actually differs on a straggling
+            # host, since lockstep collectives equalize interval_s fleet-
+            # wide (tpudist.telemetry.health.CrossProcessAggregator)
+            self.health.observe_interval(
+                step, interval_s,
+                host_s=(data_wait_s or 0.0) + (dispatch_s or 0.0),
+                mfu=mfu_val, skipped=skipped,
+            )
         return event
+
+    # -- run-health passthroughs (fit()'s loop-side hooks) -----------------
+
+    def beat(self, step: int) -> None:
+        """Feed the hang watchdog — once per loop iteration."""
+        if self.health is not None:
+            self.health.beat(step)
+
+    def observe_state(self, step: int, state) -> None:
+        """Drive the replica-divergence probe (dispatch side; resolves one
+        cadence later on the delayed pipeline)."""
+        if self.health is not None:
+            self.health.observe_state(step, state)
+
+    def mark_crashing(self) -> None:
+        """fit()'s exception handler calls this FIRST, before flushing the
+        final pending step: from here on no health path may dispatch or
+        resolve a collective (a fetch queued behind the hung collective
+        the crash interrupted would block the crash handler forever)."""
+        if self.health is not None:
+            self.health.crashing = True
+
+    def on_crash(self, exc: BaseException | None = None) -> None:
+        """fit()'s exception path: snapshot the run report with a crash
+        status before the exception propagates. Never raises — forensics
+        must not mask the original failure."""
+        if self.health is None:
+            return
+        label = type(exc).__name__ if exc is not None else "exception"
+        try:
+            # drain=False: a pending gather/probe fetch behind a HUNG
+            # collective would block this very crash handler forever —
+            # the crashed report comes from host-side state only
+            self.health.finish(status=f"crashed:{label}", drain=False)
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        """fit()'s finally-path teardown: stop the watchdog thread, then
+        close the sink (which the logger's mirrored footer must precede —
+        same ordering contract as before)."""
+        if self.health is not None:
+            self.health.shutdown()
+        self.sink.close()
 
     def finish(self, opt_state=None) -> None:
         """Final summary row (rank 0): sentry event count and — when the
         optimizer chain carries an ``amp.skip_nonfinite`` wrapper — its
-        skip counter (one host fetch, at run end only)."""
-        if self.rank != 0:
-            return
+        skip counter (one host fetch, at run end only). With run-health
+        on, also drains the delayed aggregation/probe pipelines (all
+        ranks — they hold already-dispatched collectives' results) and
+        writes the end-of-run report."""
         skips = None
-        if opt_state is not None:
+        if self.rank == 0 and opt_state is not None:
             from tpudist.amp import maybe_skipped_steps
 
             skips = maybe_skipped_steps(opt_state)
-        self.sink.write(
-            "run_summary",
-            anomaly_events=len(self.sentry.events) if self.sentry else 0,
-            optimizer_nonfinite_skips=skips,
-        )
+        if self.rank == 0:
+            self.sink.write(
+                "run_summary",
+                anomaly_events=len(self.sentry.events) if self.sentry else 0,
+                optimizer_nonfinite_skips=skips,
+            )
+        if self.health is not None:
+            self.health.finish(status="completed", optimizer_skips=skips)
 
     def __enter__(self):
         return self
@@ -550,18 +753,31 @@ def build_telemetry(
     profiler=None,
     model=None,
     input_key: str = "tokens",
+    mesh=None,
 ) -> Telemetry | None:
     """fit()'s constructor: ``False`` → None (telemetry entirely off, the
     reference TSV contract byte-identical), ``True`` → defaults, a
-    :class:`TelemetryConfig` → as configured."""
+    :class:`TelemetryConfig` → as configured. ``mesh`` enables the
+    replica-divergence probe (it needs the device mesh to build its
+    shard_map); the other health pieces work without it."""
     if not telemetry:
         return None
     config = telemetry if isinstance(telemetry, TelemetryConfig) else TelemetryConfig()
+    out_dir = Path(config.jsonl_dir or log_dir)
     sink = TelemetrySink(
-        Path(config.jsonl_dir or log_dir) / f"{job_id}_telemetry_{rank}.jsonl",
-        rank=rank,
+        out_dir / f"{job_id}_telemetry_{rank}.jsonl",
+        rank=rank, max_bytes=config.jsonl_max_bytes,
     )
-    return Telemetry(
+    tel = Telemetry(
         config, sink, model=model, input_key=input_key, profiler=profiler,
         rank=rank, world_size=world_size, log_every=log_every, n_chips=n_chips,
     )
+    if (config.run_report or config.aggregate_every
+            or config.divergence_every or config.hang_timeout_s):
+        from tpudist.telemetry.health import RunHealth
+
+        tel.health = RunHealth(
+            config, sink, job_id=job_id, log_dir=str(out_dir), mesh=mesh,
+            rank=rank, profiler=profiler, tel=tel,
+        )
+    return tel

@@ -1,0 +1,597 @@
+"""Run-health: job-level operability on top of per-rank telemetry.
+
+PR 4's telemetry answers "is this RANK healthy?" — every process writes its
+own JSONL and nobody correlates them. At multi-host scale the dominant
+failures are silent (the TPUv4 pjit experience reports, PAPERS.md): a
+straggling host dragging every synchronous step, a hung collective stalling
+the job with no output, or replicas silently desyncing so the
+"data-parallel" run quietly trains W different models. This module is the
+layer that answers "is this JOB healthy, and if it died, why?" — four
+pieces, all driven through ``fit()`` via :class:`~tpudist.telemetry
+.Telemetry` and all OFF by default (the streams stay byte-identical):
+
+- :class:`CrossProcessAggregator` — rank 0 periodically folds every rank's
+  last-seen step / step interval / host-blocked seconds into per-host skew
+  stats (a ``fleet`` row) and emits a one-shot ``straggler`` warning when
+  one host's host-side share of the step persistently exceeds the fleet
+  median. The gather is a tiny compiled all-gather over all devices whose
+  result is FETCHED one aggregation later (``copy_to_host_async``) — the
+  same delayed pipeline as the loss, so it adds no host↔device sync.
+  Synchronous SPMD equalizes ``interval_s`` across ranks (everyone waits
+  for the slowest collective), so the skew signal is ``host_s`` — the
+  seconds each rank spent blocked in ITS OWN input pipeline and dispatch,
+  which is precisely what differs on the straggling host.
+- :class:`DivergenceProbe` — drives :func:`tpudist.parallel.dp
+  .make_divergence_probe` (per-replica bit-checksums all-gathered over the
+  ``data`` axis; psum'd checksum + non-finite count for ZeRO-1-sharded
+  state) at a cadence, resolving each probe one cadence later. A mismatch
+  writes a ``divergence`` row and fires the NanSentry flight-recorder path
+  (arms the on-demand profiler window).
+- :class:`HangWatchdog` — a daemon thread with a step deadline, armed at
+  the first ``beat()``. On trip it dumps every Python thread's stack,
+  writes a ``watchdog`` row (the sink flushes per write), flushes any
+  armed profiler window, and writes a structured per-rank crash report
+  (``{job}_crash_{rank}.json``: thread stacks, last-N telemetry rows,
+  per-rank last-seen steps, anomaly/straggler/divergence history) plus the
+  end-of-run report — the forensics a hung job otherwise takes to its
+  grave. One-shot; non-fatal (a stall that resolves lets the run finish).
+- the **end-of-run report** — ``{job}_report.json`` (rank 0), written on
+  normal exit, on the crash path, and from the watchdog: step-time
+  percentiles, MFU percentiles, skipped steps, comm byte totals, anomaly /
+  straggler / divergence / watchdog history, per-rank last-seen steps, and
+  the telemetry segment list (the sink's size-capped rotation).
+
+Enable via :func:`health_config` (what ``main.py --health`` builds) or by
+setting the health fields on :class:`~tpudist.telemetry.TelemetryConfig`.
+Row kinds and the report schema: docs/OBSERVABILITY.md §7; the stuck-job
+recipe: docs/MULTIHOST.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = [
+    "CrossProcessAggregator",
+    "DivergenceProbe",
+    "HangWatchdog",
+    "RunHealth",
+    "health_config",
+    "thread_stacks",
+]
+
+
+def health_config(base=None, *, aggregate_every: int = 50,
+                  divergence_every: int = 200,
+                  hang_timeout_s: float | None = 300.0, **overrides):
+    """A :class:`~tpudist.telemetry.TelemetryConfig` with the run-health
+    layer ON at production defaults — what ``main.py --health`` passes to
+    ``fit(telemetry=...)``. ``base`` seeds the non-health fields
+    (``None`` → defaults); keyword overrides win."""
+    import dataclasses
+
+    from tpudist.telemetry import TelemetryConfig
+
+    return dataclasses.replace(
+        base or TelemetryConfig(),
+        aggregate_every=aggregate_every,
+        divergence_every=divergence_every,
+        hang_timeout_s=hang_timeout_s,
+        **overrides,
+    )
+
+
+def thread_stacks() -> dict[str, list[str]]:
+    """Formatted Python stacks of every live thread, keyed
+    ``"{name} ({ident})"`` — the crash report's view of WHERE each thread
+    is stuck (the hung-collective signature: the main thread inside a
+    jax value fetch, the prefetch thread inside its queue)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    return {
+        f"{names.get(ident, 'unknown')} ({ident})":
+            traceback.format_stack(frame)
+        for ident, frame in sys._current_frames().items()
+    }
+
+
+def _strict_json(obj):
+    """The report/crash files keep the sink's strict-JSON contract: a
+    NanSentry event carries the literal NaN loss that killed the run, and
+    bare ``json.dumps`` would emit a ``NaN`` token that breaks every
+    strict consumer of exactly the forensics file written for them.
+    Recurses via the sink's serializer (non-finite → null)."""
+    from tpudist.telemetry import _json_safe
+
+    return _json_safe(obj)
+
+
+def _percentiles(xs) -> dict | None:
+    if not xs:
+        return None
+    a = np.asarray(xs, np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 6),
+        "p90": round(float(np.percentile(a, 90)), 6),
+        "p99": round(float(np.percentile(a, 99)), 6),
+        "mean": round(float(a.mean()), 6),
+        "max": round(float(a.max()), 6),
+        "n": int(a.size),
+    }
+
+
+def _observe_bounded(lst: list, v: float, cap: int = 100_000) -> None:
+    # multi-day runs must not grow the percentile source unbounded: past
+    # the cap, drop every other sample (keeps the distribution's shape at
+    # half the resolution — fine for p50/p90/p99)
+    lst.append(float(v))
+    if len(lst) > cap:
+        del lst[::2]
+
+
+class CrossProcessAggregator:
+    """Rank 0's fold of every rank's health scalars (see module doc).
+
+    Every rank calls :meth:`on_step` once per resolved step; collective
+    work happens only at the ``every`` cadence, on the same steps on every
+    rank — lockstep by construction, like the train step itself. The
+    gathered stats per rank: last-seen step, step interval, and ``host_s``
+    (data-wait + dispatch seconds — the rank-LOCAL share of the step).
+
+    Straggler rule: at each fold, a rank's host-blocked fraction
+    ``rel = host_s / interval_s`` is compared against the fleet median;
+    a rank is a candidate when ``rel > max(ratio · median, min_frac)``
+    (the ``min_frac`` floor keeps a near-zero healthy median from turning
+    measurement noise into ratios). ``patience`` consecutive candidate
+    folds fire ONE ``straggler`` row per rank per run — a page, not a
+    stream.
+    """
+
+    def __init__(self, sink, *, every: int, ratio: float = 1.5,
+                 patience: int = 3, min_frac: float = 0.25, rank: int = 0):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self.sink = sink
+        self.every = max(int(every), 1)
+        self.ratio = float(ratio)
+        self.patience = max(int(patience), 1)
+        self.min_frac = float(min_frac)
+        self.rank = rank
+        devices = jax.devices()
+        self._slot_proc = np.asarray([d.process_index for d in devices])
+        self._procs = sorted(set(self._slot_proc.tolist()))
+        # the gather rides its own flat 1-D mesh over ALL devices — health
+        # is a job-level question, independent of how the training mesh
+        # factors them. Steps travel as an int32 channel of their own: a
+        # float32 slot rounds past 2^24, and "which rank's last-seen step
+        # trails" is exactly the multi-day diagnosis that must stay exact.
+        gmesh = Mesh(np.asarray(devices), ("g",))
+        self._in_sharding = NamedSharding(gmesh, P("g"))
+        out = NamedSharding(gmesh, P())
+        self._gather = jax.jit(
+            lambda s, f: (s, f), out_shardings=(out, out)
+        )
+        self._local = jax.local_device_count()
+        self._pending: tuple | None = None
+        self._streak: dict[int, int] = collections.defaultdict(int)
+        self._warned: set[int] = set()
+        self.last_seen: dict[int, int] = {}
+        self.straggler_events: list[dict] = []
+        self.fleet: dict | None = None
+
+    def on_step(self, step: int, interval_s: float, host_s: float) -> None:
+        if step % self.every:
+            return
+        import jax
+
+        if self._pending is not None:
+            # resolve LAST cadence's gather — its D2H started right after
+            # dispatch, so this is a host-memory read, not a device sync
+            self.flush()
+        steps_local = np.full((self._local, 1), step, np.int32)
+        floats_local = np.tile(
+            np.asarray([interval_s, host_s], np.float32), (self._local, 1)
+        )
+        n = len(self._slot_proc)
+        sarr = jax.make_array_from_process_local_data(
+            self._in_sharding, steps_local, (n, 1)
+        )
+        farr = jax.make_array_from_process_local_data(
+            self._in_sharding, floats_local, (n, 2)
+        )
+        gs, gf = self._gather(sarr, farr)
+        gs.copy_to_host_async()
+        gf.copy_to_host_async()
+        self._pending = (step, gs, gf)
+
+    def flush(self) -> None:
+        if self._pending is not None:
+            at, gs, gf = self._pending
+            self._pending = None
+            self._fold(np.asarray(gs), np.asarray(gf), at)
+
+    def _fold(self, steps: np.ndarray, floats: np.ndarray,
+              at_step: int) -> None:
+        # one row per device; every device of a process carries the same
+        # stats, so the first slot speaks for it
+        per_step = {
+            p: int(steps[self._slot_proc == p][0, 0]) for p in self._procs
+        }
+        per = {p: floats[self._slot_proc == p][0] for p in self._procs}
+        for p, s in per_step.items():
+            self.last_seen[int(p)] = s
+        if self.rank != 0:
+            return
+        intervals = {p: float(r[0]) for p, r in per.items()}
+        host = {p: float(r[1]) for p, r in per.items()}
+        rel = {
+            p: host[p] / max(intervals[p], 1e-9) for p in self._procs
+        }
+        med = float(np.median(list(rel.values())))
+        self.fleet = {
+            "per_rank_step": {str(p): per_step[p] for p in self._procs},
+            "per_rank_interval_s": {
+                str(p): round(intervals[p], 6) for p in self._procs
+            },
+            "per_rank_host_s": {
+                str(p): round(host[p], 6) for p in self._procs
+            },
+            "median_host_frac": round(med, 6),
+        }
+        self.sink.write("fleet", at_step, **self.fleet)
+        if len(self._procs) <= 1:
+            return  # a one-host fleet has no one to straggle behind
+        bar = max(self.ratio * med, self.min_frac)
+        for p in self._procs:
+            if rel[p] > bar:
+                self._streak[p] += 1
+                if self._streak[p] >= self.patience and p not in self._warned:
+                    self._warned.add(p)
+                    event = {
+                        "rank": int(p),
+                        "host_s": round(host[p], 6),
+                        "interval_s": round(intervals[p], 6),
+                        "host_frac": round(rel[p], 6),
+                        "fleet_median_frac": round(med, 6),
+                        "consecutive_folds": self._streak[p],
+                        "step": int(at_step),
+                    }
+                    self.straggler_events.append(event)
+                    self.sink.write(
+                        "straggler", at_step,
+                        **{k: v for k, v in event.items() if k != "step"},
+                        hint="this host spends an outsized share of each "
+                             "step blocked in its own input pipeline / "
+                             "dispatch; check its heartbeat drift, disk, "
+                             "and decode load (docs/MULTIHOST.md)",
+                    )
+            else:
+                self._streak[p] = 0
+
+
+class DivergenceProbe:
+    """Host driver for :func:`tpudist.parallel.dp.make_divergence_probe`:
+    dispatches the compiled probe every ``every`` steps and resolves each
+    result one cadence later (delayed fetch, no sync). A replica mismatch
+    or non-finite state writes a ``divergence`` row, records the event,
+    and calls ``on_event`` (the flight-recorder arm) — whose return value
+    lands in the row as ``profiler_armed``."""
+
+    def __init__(self, sink, mesh, *, every: int, rank: int = 0,
+                 on_event: Callable[[dict], bool] | None = None):
+        self.sink = sink
+        self.mesh = mesh
+        self.every = max(int(every), 1)
+        self.rank = rank
+        self.on_event = on_event
+        self._fn = None
+        self._disabled = False
+        self._pending: tuple | None = None
+        self.checks = 0
+        self.events: list[dict] = []
+
+    def on_step(self, step: int, state) -> None:
+        if self._disabled or step % self.every:
+            return
+        if self._pending is not None:
+            self._resolve()
+        if self._fn is None:
+            from tpudist.parallel.dp import make_divergence_probe
+
+            self._fn = make_divergence_probe(state, self.mesh)
+            if self._fn is None:  # one data replica: nothing to compare
+                self._disabled = True
+                return
+        metrics = self._fn(state)
+        for v in metrics.values():
+            v.copy_to_host_async()
+        self._pending = (step, metrics)
+
+    def flush(self) -> None:
+        if self._pending is not None:
+            self._resolve()
+
+    def _resolve(self) -> None:
+        step, metrics = self._pending
+        self._pending = None
+        host = {k: int(v) for k, v in metrics.items()}
+        self.checks += 1
+        diverged = host["replica_divergence"]
+        nonfinite = host["state_nonfinite"]
+        if diverged == 0 and nonfinite == 0:
+            return
+        event = {
+            "step": int(step),
+            "replica_divergence": diverged,
+            "state_nonfinite": nonfinite,
+            "replica_checksum": host["replica_checksum"],
+            "sharded_checksum": host["sharded_checksum"],
+        }
+        self.events.append(event)
+        armed = bool(self.on_event(event)) if self.on_event else False
+        # every rank observed the same replicated scalars; one row, rank 0
+        if self.rank == 0:
+            self.sink.write(
+                "divergence", step, profiler_armed=armed,
+                **{k: v for k, v in event.items() if k != "step"},
+                hint="data-parallel replicas no longer hold identical "
+                     "state — a missed collective, bit corruption, or a "
+                     "host resumed from the wrong step; the run is "
+                     "training divergent models (docs/OBSERVABILITY.md §7)",
+            )
+
+
+class HangWatchdog:
+    """Daemon monitor thread with a step deadline (see module doc).
+
+    Armed at the FIRST :meth:`beat` — bring-up (device attach, the first
+    compile) legitimately takes minutes and must not trip it. After that,
+    a gap of more than ``timeout_s`` between beats calls ``on_trip`` once
+    (one-shot: forensics, not a supervisor — pair with the launcher's
+    ``--max_restarts`` for recovery). Non-fatal: a stall that resolves
+    lets the run finish, with the trip recorded."""
+
+    def __init__(self, timeout_s: float, on_trip: Callable[[dict], None],
+                 *, poll_s: float | None = None):
+        self.timeout_s = float(timeout_s)
+        self._on_trip = on_trip
+        self._poll = (
+            poll_s if poll_s is not None
+            else min(max(self.timeout_s / 4.0, 0.05), 5.0)
+        )
+        self._beat: tuple[float, int] | None = None
+        self._stop = threading.Event()
+        self.tripped: dict | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="tpudist-hang-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def beat(self, step: int) -> None:
+        self._beat = (time.monotonic(), int(step))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            b = self._beat
+            if b is None:
+                continue  # not armed until the first beat
+            age = time.monotonic() - b[0]
+            if age > self.timeout_s:
+                self.tripped = {
+                    "last_step": b[1],
+                    "age_s": round(age, 3),
+                    "timeout_s": self.timeout_s,
+                    "t": time.time(),
+                }
+                try:
+                    self._on_trip(dict(self.tripped))
+                except Exception:  # forensics must never kill the monitor
+                    traceback.print_exc()
+                return  # one-shot
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=max(self._poll * 4, 1.0))
+
+
+class RunHealth:
+    """The facade ``fit()`` drives (owned by :class:`~tpudist.telemetry
+    .Telemetry`): builds whichever of the four pieces the config turns
+    on, accumulates the end-of-run report's inputs, and owns the crash
+    paths."""
+
+    def __init__(self, config, sink, *, job_id: str, log_dir: str,
+                 mesh=None, rank: int = 0, profiler=None, tel=None):
+        self.config = config
+        self.sink = sink
+        self.job_id = job_id
+        self.rank = rank
+        self.profiler = profiler
+        out = Path(log_dir)
+        self.report_path = out / f"{job_id}_report.json"
+        self.crash_path = out / f"{job_id}_crash_{rank}.json"
+        self.aggregator = (
+            CrossProcessAggregator(
+                sink, every=config.aggregate_every,
+                ratio=config.straggler_ratio,
+                patience=config.straggler_patience, rank=rank,
+            )
+            if config.aggregate_every else None
+        )
+        self.probe = (
+            DivergenceProbe(
+                sink, mesh, every=config.divergence_every, rank=rank,
+                on_event=self._arm_recorder,
+            )
+            if config.divergence_every and mesh is not None else None
+        )
+        self.watchdog = (
+            HangWatchdog(config.hang_timeout_s, self._on_trip)
+            if config.hang_timeout_s else None
+        )
+        self.intervals: list[float] = []
+        self.mfus: list[float] = []
+        self.steps_observed = 0
+        self.skipped_steps = 0
+        self._last_step = 0
+        # set by fit's exception handler BEFORE it flushes the final
+        # pending step: once crashing, no path may dispatch or RESOLVE a
+        # collective (a fetch queued behind the hung collective the crash
+        # interrupted blocks forever — inside the crash handler)
+        self.crashing = False
+        # the owning Telemetry (sentry-event history and comm stats for
+        # the reports) — constructor-injected so no caller depends on a
+        # post-hoc private assignment
+        self._tel = tel
+
+    # -- per-step drive (main thread) --------------------------------------
+
+    def beat(self, step: int) -> None:
+        if self.watchdog is not None:
+            self.watchdog.beat(step)
+
+    def observe_state(self, step: int, state) -> None:
+        if self.probe is not None and not self.crashing:
+            self.probe.on_step(step, state)
+
+    def observe_interval(self, step: int, interval_s: float, *,
+                         host_s: float = 0.0, mfu: float | None = None,
+                         skipped: int = 0) -> None:
+        self.steps_observed += 1
+        self.skipped_steps += int(skipped)
+        self._last_step = int(step)
+        _observe_bounded(self.intervals, interval_s)
+        if mfu is not None:
+            _observe_bounded(self.mfus, mfu)
+        if self.aggregator is not None and not self.crashing:
+            # the crash-path final resolve must not touch the aggregator:
+            # its on_step would FETCH the previous pending gather, which
+            # can sit queued behind the very collective that hung
+            self.aggregator.on_step(step, interval_s, host_s)
+
+    # -- flight recorder / crash forensics ---------------------------------
+
+    def _arm_recorder(self, event: dict) -> bool:
+        if self.profiler is None or not getattr(
+            self.config, "capture_on_anomaly", True
+        ):
+            return False
+        return bool(self.profiler.arm(self.config.capture_steps))
+
+    def _on_trip(self, trip: dict) -> None:
+        # runs on the watchdog thread while the main thread is (by
+        # definition) stuck — every write here must be host-local, and the
+        # ORDER is the forensic priority: when the hang is the filesystem
+        # itself, the main thread may be wedged INSIDE sink.write holding
+        # the sink lock, so the crash file (tail read with a lock timeout)
+        # and the report land on disk BEFORE anything touches the sink
+        stacks = thread_stacks()
+        crash = {
+            "v": 1,
+            "job": self.job_id,
+            "rank": self.rank,
+            "trip": trip,
+            "thread_stacks": stacks,
+            "last_rows": self.sink.tail(64, lock_timeout=2.0),
+            "per_rank_last_seen": self._last_seen(),
+            "anomalies": self._anomalies(),
+            "straggler_events": (
+                self.aggregator.straggler_events if self.aggregator else []
+            ),
+            "divergence_events": self.probe.events if self.probe else [],
+        }
+        self.crash_path.write_text(json.dumps(_strict_json(crash), indent=1))
+        self._write_report("watchdog")
+        if self.profiler is not None:
+            # an armed anomaly window dies unwritten with a hung process;
+            # flush what the runtime has
+            self.profiler.flush_armed()
+        self.sink.write(
+            "watchdog", step=trip["last_step"], age_s=trip["age_s"],
+            timeout_s=trip["timeout_s"],
+            hint="no step completed inside the deadline — hung collective "
+                 "or dead input pipeline; crash report at "
+                 f"{self.crash_path} (docs/MULTIHOST.md: Diagnosing a "
+                 "stuck job)",
+        )
+
+    # -- report ------------------------------------------------------------
+
+    def _last_seen(self) -> dict:
+        if self.aggregator is not None and self.aggregator.last_seen:
+            return {
+                str(k): v for k, v in sorted(self.aggregator.last_seen.items())
+            }
+        return {str(self.rank): self._last_step}
+
+    def _anomalies(self) -> list:
+        tel = self._tel
+        if tel is not None and tel.sentry is not None:
+            return list(tel.sentry.events)
+        return []
+
+    def finish(self, status: str = "completed", *,
+               optimizer_skips: int | None = None,
+               drain: bool = True) -> None:
+        """Drain the delayed pipelines and write the report. Called on all
+        ranks (the flushes resolve already-dispatched collectives); the
+        report file itself is rank 0's. The crash path passes
+        ``drain=False``: resolving a pending gather/probe means fetching a
+        collective's value, and when the crash IS an interrupt of a hung
+        collective that fetch would block forever — the crash report must
+        come from host-side state only."""
+        if drain:
+            if self.aggregator is not None:
+                self.aggregator.flush()
+            if self.probe is not None:
+                self.probe.flush()
+        self._write_report(status, optimizer_skips=optimizer_skips)
+
+    def shutdown(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+
+    def _write_report(self, status: str,
+                      optimizer_skips: int | None = None) -> dict | None:
+        if self.rank != 0 or not getattr(self.config, "run_report", True):
+            return None
+        tel = self._tel
+        comm = getattr(tel, "_comm", None) if tel is not None else None
+        report = {
+            "v": 1,
+            "job": self.job_id,
+            "status": status,
+            "t": round(time.time(), 3),
+            "steps_observed": self.steps_observed,
+            "step_time_s": _percentiles(self.intervals),
+            "mfu": _percentiles(self.mfus),
+            "skipped_steps": self.skipped_steps,
+            "optimizer_nonfinite_skips": optimizer_skips,
+            "anomaly_events": self._anomalies(),
+            "straggler_events": (
+                self.aggregator.straggler_events if self.aggregator else []
+            ),
+            "divergence_events": self.probe.events if self.probe else [],
+            "divergence_checks": self.probe.checks if self.probe else 0,
+            "watchdog": self.watchdog.tripped if self.watchdog else None,
+            "per_rank_last_seen": self._last_seen(),
+            "fleet": self.aggregator.fleet if self.aggregator else None,
+            "comm": comm,
+            "comm_bytes_total": (
+                comm["bytes_per_step"] * self.steps_observed
+                if comm and "bytes_per_step" in comm else None
+            ),
+            "telemetry_segments": [str(p) for p in self.sink.segments()],
+        }
+        report = _strict_json(report)
+        self.report_path.write_text(json.dumps(report, indent=1))
+        return report

@@ -647,7 +647,13 @@ def fit(
     models that advertise a ``flops_counter``, and per-process heartbeat
     rows — all into a ``{job_id}_telemetry_{rank}.jsonl`` stream next to
     the TSV, which stays byte-identical to the reference contract when
-    telemetry is off.
+    telemetry is off. The run-health layer rides the same config
+    (``tpudist.telemetry.health``, docs/OBSERVABILITY.md §7): cross-process
+    straggler aggregation, a replica-divergence probe, a hang watchdog
+    with crash forensics, and a ``{job_id}_report.json`` end-of-run report
+    written on normal exit AND from the crash/watchdog paths — the health
+    detectors are off unless their config fields are set
+    (``tpudist.telemetry.health.health_config`` is the production preset).
 
     ``memory_log_every`` cadences ``MetricsLogger.log_memory`` (live HBM
     rows) during training: ``None`` (default) auto-selects ``log_every·10``
@@ -841,7 +847,7 @@ def fit(
                 job_id=job_id, log_dir=log_dir, rank=global_rank,
                 world_size=world_size, log_every=logger.log_every,
                 n_chips=jax.device_count(), profiler=p, model=model,
-                input_key=input_key,
+                input_key=input_key, mesh=mesh,
             )
             if tel is not None:
                 logger.attach_sink(tel.sink)
@@ -969,6 +975,17 @@ def fit(
                         dispatch_s = time.perf_counter() - dispatch_t0
                         for v in metrics.values():
                             v.copy_to_host_async()
+                        if tel is not None:
+                            # run-health hooks (no-ops unless configured):
+                            # the watchdog beat marks "the loop is alive"
+                            # once per iteration — placed AFTER dispatch so
+                            # bring-up's first compile sits before the
+                            # first beat and can't false-trip the deadline
+                            # — and the divergence probe dispatches on the
+                            # fresh state at its cadence (async; resolved
+                            # one cadence later on the delayed pipeline)
+                            tel.beat(global_step)
+                            tel.observe_state(global_step, state)
                         device_s = None
                         if breakdown:
                             if (global_step + probe_offset) % tel.log_every == 0:
@@ -1010,17 +1027,26 @@ def fit(
                             logger.log_memory(device_memory_stats())
                         if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
                             ckpt.save(state)
-            except BaseException:
+            except BaseException as crash_exc:
                 # flush the last completed step before the exception leaves:
                 # the loss history and TSV then end at the step that actually
                 # finished, not one row short — but never mask the original
                 # exception with a fetch failure (e.g. the device itself died)
+                if tel is not None:
+                    # BEFORE the resolve: its on_step must not fetch a
+                    # pending health gather that may sit queued behind
+                    # the very collective that hung
+                    tel.mark_crashing()
                 if pending is not None:
                     try:
                         resolve(time.time())
                     except Exception:
                         pass
                     pending = None
+                if tel is not None:
+                    # crash-path run report (tpudist.telemetry.health):
+                    # status + everything observed so far; never raises
+                    tel.on_crash(crash_exc)
                 raise
             else:
                 if pending is not None:
@@ -1033,9 +1059,10 @@ def fit(
     finally:
         # closed here, OUTSIDE the logger's context: the logger's __exit__
         # mirrors its TrainTime footer into the sink (dual-sink mode), so
-        # the sink must outlive it
+        # the sink must outlive it (shutdown also stops the hang-watchdog
+        # thread before the sink goes away)
         if tel is not None:
-            tel.sink.close()
+            tel.shutdown()
         if ckpt:
             ckpt.close()
     return state, losses
