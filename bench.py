@@ -86,6 +86,15 @@ Legs
    probe + cross-process aggregation gather at a 10-step cadence
    (interleaved A/B); must stay under 1% step-time overhead
    (docs/OBSERVABILITY.md §7).
+16. ``gpt2_124m_preempt_recovery_s`` — the resilience layer's recovery
+   drill (docs/MULTIHOST.md "Surviving preemption"): a supervised 124M
+   run is chaos-SIGTERM'd mid-stream; the trainer writes its synchronous
+   emergency checkpoint and exits 75, ``tpudist.launch`` relaunches
+   generation 1, and the run resumes where it stopped. value = the
+   recovery cost in wall seconds (emergency save + restart gap + resumed
+   generation's bring-up/restore/compile — ``goodput.cumulative
+   .restart_overhead_s`` from the run report); vs_baseline = target /
+   value, so >= 1.0 means recovery lands under the bound.
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -1375,6 +1384,127 @@ def bench_run_health() -> None:
     )
 
 
+TARGET_PREEMPT_RECOVERY_S = 180.0  # recovery must cost < 3 min of goodput
+
+_PREEMPT_CHILD = """
+import os
+
+if os.environ.get("TPUDIST_FORCE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+import jax
+import numpy as np
+import optax
+
+from tpudist import create_mesh, init_from_env
+from tpudist.data.loader import DataLoader
+from tpudist.models.gpt2 import GPT2
+from tpudist.telemetry import TelemetryConfig
+from tpudist.train import fit, lm_loss
+
+ctx = init_from_env()
+mesh = create_mesh()
+out = os.environ["OUT_DIR"]
+n = jax.device_count()
+seq, per_chip, n_batches = 256, 4, 24
+rng = np.random.Generator(np.random.PCG64(0))
+tokens = rng.integers(
+    0, 50257, (per_chip * n * n_batches, seq)
+).astype(np.int32)
+loader = DataLoader({"tokens": tokens}, per_chip * n)
+model = GPT2(max_seq_len=seq, mesh=mesh)  # the 124M geometry
+cfg = TelemetryConfig(sentry=False, mfu=False, breakdown=False,
+                      heartbeat_every=0)
+# generation 0 is SIGTERM'd after step 10 (the chaos drill); the
+# supervisor relaunches generation 1, which resumes at step 11 and runs
+# to completion — fit() raising Preempted IS the exit-75 path
+fit(
+    model, optax.adam(1e-4), loader,
+    epochs=1, mesh=mesh, profile=False,
+    job_id="PreemptBench", log_dir=out,
+    loss_fn=lm_loss, input_key="tokens", label_key="tokens",
+    telemetry=cfg,
+    checkpoint_dir=os.path.join(out, "ckpt"), checkpoint_every=5,
+    chaos="sigterm@10",
+)
+"""
+
+
+def bench_preempt_recovery() -> None:
+    """The recovery drill (leg 16): run the supervised preempt → emergency
+    save → relaunch → resume loop for real and price it from the run
+    report's cross-generation goodput section. This leg deliberately does
+    NOT touch jax in-process: the trainer generations each own the
+    accelerator attach, and the launcher's drain guarantees generation 1
+    never races generation 0's dying process for it."""
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+
+    out = pathlib.Path(tempfile.mkdtemp(prefix="tpudist_preempt_bench_"))
+    script = out / "child.py"
+    script.write_text(_PREEMPT_CHILD)
+    env = dict(os.environ)
+    env["OUT_DIR"] = str(out)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "tpudist.launch",
+            "--nproc_per_node=1", "--max_restarts=0",
+            f"--master_port={29500 + os.getpid() % 499 + 1}",
+            str(script),
+        ],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=2100,
+    )
+    wall = time.perf_counter() - t0
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"preempt-recovery drill failed rc={r.returncode}:\n"
+            f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+        )
+    report = json.loads((out / "PreemptBench_report.json").read_text())
+    good = report["goodput"]
+    cum = good["cumulative"]
+    gens = good["generations"]
+    assert report["generation"] == 1 and len(gens) == 2, report
+    recovery_s = cum["restart_overhead_s"]
+    resumed = gens[1]
+    _record_line(
+        {
+            "metric": "gpt2_124m_preempt_recovery_s",
+            "value": round(recovery_s, 2),
+            "unit": "wall seconds a mid-run preemption costs end to end "
+            "(chaos SIGTERM at step 10 of a supervised GPT-2 124M run): "
+            "synchronous emergency save "
+            f"{round(sum(g['emergency_save_s'] for g in gens), 2)}s + "
+            f"restart gap {round(cum['restart_gap_s'], 2)}s + resumed "
+            "generation's bring-up/restore/compile "
+            f"{round(resumed['bringup_s'] + resumed['restore_s'] + resumed['compile_s'], 2)}s "
+            "— goodput.cumulative.restart_overhead_s from the run report "
+            f"(whole drill: {round(wall, 1)}s wall, cumulative productive "
+            f"frac {cum['productive_frac']}); vs_baseline = "
+            f"{TARGET_PREEMPT_RECOVERY_S:.0f}s target / value — >= 1.0 "
+            "means recovery costs under the bound (docs/MULTIHOST.md)",
+            "emergency_save_s": round(
+                sum(g["emergency_save_s"] for g in gens), 3
+            ),
+            "restart_gap_s": round(cum["restart_gap_s"], 3),
+            "resume_bringup_s": round(
+                resumed["bringup_s"] + resumed["restore_s"]
+                + resumed["compile_s"], 3,
+            ),
+            "cumulative_productive_frac": cum["productive_frac"],
+            "vs_baseline": round(
+                TARGET_PREEMPT_RECOVERY_S / max(recovery_s, 1e-9), 4
+            ),
+        }
+    )
+
+
 def bench_comm_efficiency() -> None:
     """The communication-efficiency legs (docs/PERF.md §11).
 
@@ -1503,6 +1633,9 @@ _LEG_GROUPS = {
     # one compile of the 124M step + the probe/gather programs + 2x4x10
     # measured steps
     "health": (bench_run_health, 1800),
+    # two full trainer generations (the resumed one recompiles through
+    # the persistent cache) + the supervised relaunch between them
+    "preempt": (bench_preempt_recovery, 2400),
 }
 
 

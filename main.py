@@ -152,6 +152,20 @@ def parse_args(argv=None):
     parser.add_argument("--divergence_every", default=200, type=int,
                         help="with --health: steps between replica-"
                         "checksum divergence probes (0 disables the probe)")
+    parser.add_argument("--hang_action", default="report",
+                        choices=["report", "exit"],
+                        help="with --health: what the hang watchdog does "
+                        "after writing its crash forensics — 'report' "
+                        "(non-fatal, the pre-resilience behavior) or "
+                        "'exit' (terminate with the restartable code 76 "
+                        "so tpudist.launch relaunches from the last "
+                        "checkpoint; docs/MULTIHOST.md)")
+    parser.add_argument("--chaos", default=None, type=str,
+                        help="fault injection for recovery drills "
+                        "(tpudist.resilience.chaos): '<kind>[:<seconds>]"
+                        "@<step>[@<generation>|@*]' with kind in "
+                        "crash/hang/sigterm — e.g. 'sigterm@50' rehearses "
+                        "a preemption after step 50 of generation 0")
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
     parser.add_argument("--checkpoint_dir", default=None, type=str,
@@ -159,6 +173,14 @@ def parse_args(argv=None):
                         "reference has no persistence, SURVEY.md §5)")
     parser.add_argument("--checkpoint_every", default=0, type=int,
                         help="steps between checkpoints (0 = end of run only)")
+    parser.add_argument("--checkpoint_every_s", default=0.0, type=float,
+                        help="WALL-CLOCK seconds between checkpoints, "
+                        "alongside --checkpoint_every (a save triggers "
+                        "when either is due; any save resets this clock, "
+                        "the step knob stays step-aligned) — the knob "
+                        "that bounds preemption loss to 'at most M "
+                        "minutes of work' on runs with variable step "
+                        "times (0 = off)")
     parser.add_argument("--no_resume", action="store_true")
     parser.add_argument("--eval", action="store_true",
                         help="run the top-1 eval pass after training — the "
@@ -387,6 +409,7 @@ def main(argv=None):
         telemetry = health_config(
             divergence_every=args.divergence_every,
             hang_timeout_s=args.hang_timeout or None,
+            hang_action=args.hang_action,
         )
     state, losses = fit(
         model, tx, loader,
@@ -404,7 +427,9 @@ def main(argv=None):
         telemetry=telemetry,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        checkpoint_every_s=args.checkpoint_every_s or None,
         resume=not args.no_resume,
+        chaos=args.chaos,
     )
 
     if args.amp and ctx.process_index == 0:

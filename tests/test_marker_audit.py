@@ -79,3 +79,52 @@ def test_plugin_passes_marked_slow_test(tmp_path):
     """))
     assert r.returncode == 0, r.stdout + r.stderr
     assert "within the" in r.stdout  # the all-clear summary line
+
+
+# -- the world rule: subprocess-world tests must be marked slow --------------
+
+# the pattern is assembled by concatenation so THIS module's source never
+# contains it — the audit would otherwise flag test_marker_audit itself
+_WORLD = "tpudist" + ".launch"
+
+
+def test_spawns_world_and_world_offenders_rules():
+    assert marker_audit.spawns_world(f'cmd = [sys.executable, "-m", "{_WORLD}"]')
+    assert marker_audit.spawns_world("argv += ['--emulate" + "-devices=4']")
+    assert not marker_audit.spawns_world("import subprocess\nrun(['ls'])")
+    records = [
+        ("tests/w.py::test_world_unmarked", True, False),
+        ("tests/w.py::test_world_marked", True, True),   # slow: exempt
+        ("tests/a.py::test_plain", False, False),
+    ]
+    assert marker_audit.world_offenders(records) == [
+        "tests/w.py::test_world_unmarked"
+    ]
+
+
+def test_plugin_flags_unmarked_world_test(tmp_path):
+    # the child module spawns a world (by source inspection) but its test
+    # is not marked slow: flagged at COLLECTION, before any cost is paid
+    r = _run_child_pytest(tmp_path, textwrap.dedent(f"""
+        LAUNCH = "{_WORLD}"  # would be subprocess.run([..., "-m", LAUNCH])
+
+        def test_spawns_a_world():
+            pass
+    """), budget="1000")
+    assert r.returncode == marker_audit.EXIT_OFFENDERS, r.stdout + r.stderr
+    assert "subprocess world" in r.stdout
+    assert "test_spawns_a_world" in r.stdout
+
+
+def test_plugin_passes_marked_world_test(tmp_path):
+    r = _run_child_pytest(tmp_path, textwrap.dedent(f"""
+        import pytest
+
+        LAUNCH = "{_WORLD}"
+
+        pytestmark = pytest.mark.slow
+
+        def test_spawns_a_world():
+            pass
+    """), budget="1000")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,389 @@
+"""Unit contracts of tpudist.resilience: the exit-code contract, the
+supervisor's backoff/budget/decision math (pure, injected clocks/rngs),
+chaos-spec parsing and firing semantics, the signal-safe preemption
+guard, goodput's exact wall-time partition and cross-generation
+aggregation, and the watchdog's ``hang_action="exit"`` escalation
+ordering (forensics first, exit second)."""
+
+import itertools
+import json
+import os
+import random
+import signal
+
+import pytest
+
+from tpudist.resilience import (
+    EXIT_HANG,
+    EXIT_INTERRUPT,
+    EXIT_PREEMPTED,
+    GENERATION_ENV,
+    BackoffPolicy,
+    ChaosCrash,
+    ChaosInjector,
+    ChaosSpec,
+    GoodputTracker,
+    Preempted,
+    PreemptionGuard,
+    RestartBudget,
+    Supervisor,
+    classify,
+    is_restartable,
+    restart_generation,
+)
+
+
+# -- exit codes --------------------------------------------------------------
+
+def test_exit_code_contract():
+    assert EXIT_PREEMPTED == 75 and EXIT_HANG == 76
+    assert is_restartable(75) and is_restartable(76)
+    # crashes, signal deaths (negative from Popen), and operator stops
+    # are NOT deliberate checkpoint-and-exit codes
+    for rc in (0, 1, 9, 130, -9, -15, 77):
+        assert not is_restartable(rc)
+    assert classify(0) == "ok"
+    assert classify(EXIT_INTERRUPT) == "stop"
+    assert classify(75) == "restartable" and classify(76) == "restartable"
+    assert classify(1) == "crash" and classify(-9) == "crash"
+
+
+def test_restart_generation_env(monkeypatch):
+    monkeypatch.delenv(GENERATION_ENV, raising=False)
+    assert restart_generation() == 0
+    monkeypatch.setenv(GENERATION_ENV, "3")
+    assert restart_generation() == 3
+    monkeypatch.setenv(GENERATION_ENV, "garbage")
+    assert restart_generation() == 0  # tolerant: telemetry must not die
+
+
+def test_preempted_is_systemexit_75():
+    e = Preempted(signal.SIGTERM, step=12)
+    assert isinstance(e, SystemExit) and e.code == EXIT_PREEMPTED
+    assert "SIGTERM" in str(e) and "12" in str(e)
+
+
+# -- supervisor math ---------------------------------------------------------
+
+def test_backoff_growth_and_cap():
+    policy = BackoffPolicy(base_s=1.0, max_s=8.0, jitter=0.0)
+    rng = random.Random(0)
+    assert [policy.delay_s(a, rng) for a in range(1, 7)] == [
+        1.0, 2.0, 4.0, 8.0, 8.0, 8.0
+    ]
+    assert policy.delay_s(0, rng) == 0.0
+    assert BackoffPolicy(base_s=0.0).delay_s(3, rng) == 0.0
+
+
+def test_backoff_jitter_bounds():
+    policy = BackoffPolicy(base_s=2.0, max_s=64.0, jitter=0.5)
+    rng = random.Random(1)
+    for attempt in range(1, 6):
+        base = min(2.0 * 2 ** (attempt - 1), 64.0)
+        for _ in range(50):
+            d = policy.delay_s(attempt, rng)
+            assert 0.5 * base <= d <= 1.5 * base
+
+
+def test_restart_budget_rolling_window():
+    t = {"now": 0.0}
+    budget = RestartBudget(2, 100.0, clock=lambda: t["now"])
+    assert budget.allow()
+    budget.record()
+    budget.record()
+    assert not budget.allow() and budget.used() == 2
+    t["now"] = 101.0  # both stamps age out of the window
+    assert budget.allow() and budget.used() == 0
+    # 0 = unlimited (the legacy launcher behavior)
+    unlimited = RestartBudget(0, 0.0)
+    for _ in range(100):
+        unlimited.record()
+    assert unlimited.allow()
+
+
+def _supervisor(rcs, **kw):
+    seen_gens = []
+    it = iter(rcs)
+
+    def run_world(generation):
+        seen_gens.append(generation)
+        return next(it)
+
+    sleeps = []
+    logs = []
+    sup = Supervisor(
+        run_world,
+        sleep=sleeps.append,
+        log=logs.append,
+        rng=random.Random(0),
+        **kw,
+    )
+    return sup, seen_gens, sleeps, logs
+
+
+def test_supervisor_restartable_fast_path_ignores_max_restarts():
+    # 75/76 mean "state durable, relaunch me": they restart with
+    # max_restarts=0 and no backoff, each generation numbered
+    sup, gens, sleeps, logs = _supervisor(
+        [75, 76, 0], max_restarts=0, budget=RestartBudget(10, 600.0)
+    )
+    assert sup.run() == 0
+    assert gens == [0, 1, 2]
+    assert sleeps == []  # prompt relaunch, no crash backoff
+    assert all("restartable" in m for m in logs)
+
+
+def test_supervisor_crash_respects_max_restarts_with_backoff():
+    sup, gens, sleeps, logs = _supervisor(
+        [9, 9, 9], max_restarts=2,
+        backoff=BackoffPolicy(1.0, 60.0, jitter=0.0),
+    )
+    assert sup.run() == 9
+    assert gens == [0, 1, 2]  # initial world + 2 restarts, then give up
+    assert sleeps == [1.0, 2.0]  # exponential
+    assert any("restarting (1/2)" in m for m in logs)
+    assert any("restarting (2/2)" in m for m in logs)
+
+
+def test_supervisor_budget_exhausts_instead_of_spinning():
+    # a deterministically-failing world must exit non-zero, not loop:
+    # the rolling budget is the circuit breaker even on the restartable
+    # fast path (an instantly-re-preempted job is a spin too)
+    sup, gens, sleeps, logs = _supervisor(
+        itertools.repeat(75), max_restarts=0,
+        budget=RestartBudget(3, 600.0),
+    )
+    assert sup.run() == 75
+    assert gens == [0, 1, 2, 3]  # initial + 3 budgeted restarts
+    assert any("restart budget exhausted" in m for m in logs)
+
+
+def test_supervisor_operator_stop_wins():
+    stop = {"on": False}
+
+    def run_world(generation):
+        stop["on"] = True  # SIGTERM landed while the world ran
+        return 75
+
+    sup = Supervisor(run_world, stop=lambda: stop["on"],
+                     budget=RestartBudget(10, 600.0), log=lambda m: None)
+    assert sup.run() == 75  # no restart over an operator stop
+
+
+# -- chaos -------------------------------------------------------------------
+
+def test_chaos_spec_parse():
+    assert ChaosSpec.parse("crash@12") == ChaosSpec("crash", 12)
+    assert ChaosSpec.parse("sigterm@5@1") == ChaosSpec(
+        "sigterm", 5, generation=1
+    )
+    s = ChaosSpec.parse("hang:30@7@*")
+    assert (s.kind, s.step, s.duration_s, s.generation) == (
+        "hang", 7, 30.0, None
+    )
+    for bad in ("boom@3", "crash", "crash:5@3", "crash@x"):
+        with pytest.raises(ValueError):
+            ChaosSpec.parse(bad)
+
+
+def test_chaos_crash_fires_once_at_step():
+    inj = ChaosInjector(ChaosSpec.parse("crash@5"), generation=0)
+    for step in range(5):
+        assert inj.maybe_fire(step) is False
+    with pytest.raises(ChaosCrash, match="step 5"):
+        inj.maybe_fire(5)
+    assert inj.fired
+    assert inj.maybe_fire(6) is False  # one-shot
+
+
+def test_chaos_generation_gating():
+    # default: the incident happens in generation 0 only — the relaunched
+    # generation resumes AT the trigger step and must not re-fire
+    gen1 = ChaosInjector(ChaosSpec.parse("crash@5"), generation=1)
+    assert gen1.maybe_fire(5) is False and not gen1.fired
+    # '@*' fires in every generation (a deterministic bug)
+    star = ChaosInjector(ChaosSpec.parse("crash@5@*"), generation=4)
+    with pytest.raises(ChaosCrash):
+        star.maybe_fire(5)
+
+
+def test_chaos_hang_and_sigterm_mechanics():
+    slept = []
+    inj = ChaosInjector(ChaosSpec.parse("hang:12@2"), generation=0,
+                        sleep=slept.append)
+    assert inj.maybe_fire(2) is True
+    assert slept == [12.0]
+
+    kills = []
+    inj = ChaosInjector(ChaosSpec.parse("sigterm@3"), generation=0,
+                        kill=lambda pid, sig: kills.append((pid, sig)))
+    assert inj.maybe_fire(3) is True
+    assert kills == [(os.getpid(), signal.SIGTERM)]
+
+
+# -- preemption guard --------------------------------------------------------
+
+def test_preemption_guard_traps_absorbs_and_restores():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert guard.active and guard.tripped is None
+        os.kill(os.getpid(), signal.SIGTERM)
+        # delivered synchronously: we ARE the main thread
+        assert guard.tripped == signal.SIGTERM
+        # repeats are absorbed while the graceful path runs
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.tripped == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_preemption_guard_disabled_is_inert():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=False) as guard:
+        assert not guard.active and guard.tripped is None
+        assert signal.getsignal(signal.SIGTERM) == before
+
+
+# -- goodput -----------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_goodput_partition_sums_exactly():
+    clk = _Clock()
+    wall = _Clock()
+    wall.now = 1000.0
+    gp = GoodputTracker(generation=0, clock=clk, wall=wall)
+    gp.add("restore_s", 0.5)          # measured inside bring-up
+    clk.now = 2.0
+    gp.loop_started()                  # bringup = 2.0 - restore = 1.5
+    clk.now = 5.0
+    gp.step_boundary()                 # iteration 1 = compile = 3.0
+    clk.now = 6.0
+    gp.step_boundary(data_wait_s=0.25)
+    gp.add("checkpoint_s", 0.3)
+    clk.now = 8.0
+    wall.now = 1008.0
+    s = gp.summary("completed")
+    assert s["total_s"] == 8.0
+    assert (s["bringup_s"], s["restore_s"], s["compile_s"]) == (1.5, 0.5, 3.0)
+    assert (s["data_wait_s"], s["checkpoint_s"]) == (0.25, 0.3)
+    # productive is the residual — the components sum EXACTLY
+    parts = sum(
+        s[k] for k in ("bringup_s", "restore_s", "compile_s",
+                       "data_wait_s", "checkpoint_s", "productive_step_s")
+    )
+    assert parts == pytest.approx(s["total_s"], rel=1e-9)
+    assert s["steps"] == 2
+    assert s["generations"][-1]["exit_reason"] == "completed"
+
+
+def test_goodput_cross_generation_aggregation(tmp_path):
+    # generation 0: preempted after an emergency save
+    clk0, wall0 = _Clock(), _Clock()
+    wall0.now = 100.0
+    g0 = GoodputTracker(generation=0, clock=clk0, wall=wall0)
+    g0.loop_started()
+    clk0.now = 1.0
+    g0.step_boundary()
+    g0.add_emergency_save(2.0)
+    clk0.now = 10.0
+    wall0.now = 110.0
+    report = {"goodput": g0.summary("preempted")}
+    path = tmp_path / "J_report.json"
+    path.write_text(json.dumps(report))
+    assert report["goodput"]["emergency_save_s"] == 2.0
+    # emergency save is a subset of checkpoint_s (partition stays disjoint)
+    assert report["goodput"]["checkpoint_s"] == 2.0
+
+    # generation 1 relaunches 7 wall-seconds later and resumes
+    clk1, wall1 = _Clock(), _Clock()
+    wall1.now = 117.0
+    g1 = GoodputTracker(generation=1, clock=clk1, wall=wall1)
+    g1.load_previous(path)
+    g1.add("restore_s", 1.0)
+    clk1.now = 3.0
+    g1.loop_started()                 # bringup = 2.0
+    clk1.now = 7.0
+    g1.step_boundary()                # compile = 4.0
+    clk1.now = 12.0
+    wall1.now = 129.0
+    s = g1.summary("completed")
+    gens = s["generations"]
+    assert [g["generation"] for g in gens] == [0, 1]
+    assert gens[0]["exit_reason"] == "preempted"
+    cum = s["cumulative"]
+    assert cum["restart_gap_s"] == pytest.approx(7.0)   # 117 - 110
+    # recovery price: gap + gen1 bringup/restore/compile + emergency save
+    assert cum["restart_overhead_s"] == pytest.approx(
+        7.0 + (2.0 + 1.0 + 4.0) + 2.0
+    )
+    assert cum["wall_s"] == pytest.approx(10.0 + 12.0 + 7.0)
+
+
+def test_goodput_load_previous_tolerates_garbage(tmp_path):
+    gp = GoodputTracker()
+    gp.load_previous(tmp_path / "missing.json")
+    (tmp_path / "bad.json").write_text("{not json")
+    gp.load_previous(tmp_path / "bad.json")
+    assert gp.summary()["generations"][-1]["generation"] == 0
+
+
+# -- watchdog escalation -----------------------------------------------------
+
+def test_hang_action_exit_escalates_after_forensics(tmp_path):
+    from tpudist.telemetry import TelemetryConfig, TelemetrySink
+    from tpudist.telemetry.health import RunHealth
+
+    sink = TelemetrySink(tmp_path / "HX_telemetry_0.jsonl")
+    cfg = TelemetryConfig(hang_timeout_s=60.0, hang_action="exit")
+    order = []
+    health = RunHealth(cfg, sink, job_id="HX", log_dir=str(tmp_path),
+                       exit_fn=lambda code: order.append(("exit", code)))
+    # fit wires the checkpointer's wait here: an in-flight async save must
+    # get its bounded finalize window BEFORE the process dies
+    health.set_exit_drain(lambda: order.append("drain"))
+    try:
+        health._on_trip(
+            {"last_step": 3, "age_s": 9.9, "timeout_s": 60.0, "t": 0.0}
+        )
+    finally:
+        health.shutdown()
+        sink.close()
+    # escalated with the restartable hang code — but only AFTER the
+    # forensics landed (crash file, report, row) and the checkpoint
+    # drain ran
+    assert order == ["drain", ("exit", EXIT_HANG)]
+    crash = json.loads((tmp_path / "HX_crash_0.json").read_text())
+    assert crash["trip"]["last_step"] == 3
+    report = json.loads((tmp_path / "HX_report.json").read_text())
+    assert report["status"] == "watchdog"
+    assert report["exit_reason"] == "hang"
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "HX_telemetry_0.jsonl").read_text().splitlines()
+    ]
+    assert any(r["kind"] == "watchdog" for r in rows)
+
+
+def test_hang_action_report_does_not_exit(tmp_path):
+    from tpudist.telemetry import TelemetryConfig, TelemetrySink
+    from tpudist.telemetry.health import RunHealth
+
+    sink = TelemetrySink(tmp_path / "HR_telemetry_0.jsonl")
+    cfg = TelemetryConfig(hang_timeout_s=60.0)  # default action: report
+    exits = []
+    health = RunHealth(cfg, sink, job_id="HR", log_dir=str(tmp_path),
+                       exit_fn=exits.append)
+    try:
+        health._on_trip(
+            {"last_step": 1, "age_s": 2.0, "timeout_s": 60.0, "t": 0.0}
+        )
+    finally:
+        health.shutdown()
+        sink.close()
+    assert exits == []  # non-fatal: the pre-resilience contract

@@ -14,6 +14,15 @@ status 3) listing any test that exceeded the per-test budget without the
 30 — generous against the measured suite, where the slowest properly
 tier-1 tests sit in the low-20s cold).
 
+Second rule, enforced at COLLECTION (no need to pay the runtime to catch
+the offender): a test whose module spawns a subprocess *world* — launches
+``tpudist.launch`` or ``--emulate-devices`` children, each of which
+cold-compiles its own jax programs with no shared persistent-cache
+warmth guarantee — must carry the ``slow`` marker. Every such test
+measured to date sits far past the per-test budget, and the duration
+rule only catches it after burning the budget once; the source rule
+catches it before it ever runs.
+
 Three ways to run it:
 
 - ``python tools/marker_audit.py`` — runs the tier-1 selection
@@ -56,7 +65,54 @@ def offenders(records, budget: float) -> list[tuple[str, float]]:
     return sorted(bad, key=lambda r: -r[1])
 
 
+# source substrings that mean "this module launches a subprocess world":
+# the launcher module itself (python -m tpudist.launch) or the emulated
+# per-process device split only the launcher consumes. Checked against the
+# test FILE's source — a world is spawned from module-level harness
+# strings as often as from the test body.
+WORLD_PATTERNS = ("tpudist.launch", "--emulate-devices")
+
+
+def spawns_world(source: str) -> bool:
+    return any(p in source for p in WORLD_PATTERNS)
+
+
+def world_offenders(records) -> list[str]:
+    """``nodeid`` for every collected test whose module spawns a
+    subprocess world but which is NOT marked ``slow`` — flagged at
+    collection, before the cost is ever paid. ``records`` rows are
+    ``(nodeid, spawns_world, is_slow)``."""
+    return [
+        nodeid
+        for nodeid, spawns, is_slow in records
+        if spawns and not is_slow
+    ]
+
+
 # -- pytest plugin hooks ----------------------------------------------------
+
+_world_records: list[tuple[str, bool, bool]] = []
+
+
+def pytest_collection_modifyitems(config, items):
+    # the world rule runs at collection: read each collected test FILE's
+    # source once (cached per path) and flag unmarked tests in
+    # world-spawning modules before they execute
+    sources: dict[str, bool] = {}
+    for item in items:
+        path = str(item.fspath)
+        if path not in sources:
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    sources[path] = spawns_world(f.read())
+            except OSError:
+                sources[path] = False
+        _world_records.append((
+            item.nodeid,
+            sources[path],
+            "slow" in item.keywords,
+        ))
+
 
 def pytest_runtest_logreport(report):
     if report.when != "call":
@@ -70,23 +126,34 @@ def pytest_runtest_logreport(report):
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     bad = offenders(_records, budget_s())
-    if not bad:
+    worlds = world_offenders(_world_records)
+    if not bad and not worlds:
         terminalreporter.write_line(
             f"marker audit: all {len(_records)} tests within the "
             f"{budget_s():.0f}s per-test budget or marked slow"
         )
         return
-    terminalreporter.write_line(
-        f"marker audit FAILED: {len(bad)} test(s) exceeded the "
-        f"{budget_s():.0f}s per-test budget without the 'slow' marker "
-        "(tier-1 window erosion — mark them slow or make them cheap):",
-    )
-    for nodeid, duration in bad:
-        terminalreporter.write_line(f"  {duration:8.1f}s  {nodeid}")
+    if bad:
+        terminalreporter.write_line(
+            f"marker audit FAILED: {len(bad)} test(s) exceeded the "
+            f"{budget_s():.0f}s per-test budget without the 'slow' marker "
+            "(tier-1 window erosion — mark them slow or make them cheap):",
+        )
+        for nodeid, duration in bad:
+            terminalreporter.write_line(f"  {duration:8.1f}s  {nodeid}")
+    if worlds:
+        terminalreporter.write_line(
+            f"marker audit FAILED: {len(worlds)} test(s) spawn a "
+            "subprocess world (tpudist.launch / --emulate-devices "
+            "children cold-compile their own jax programs) without the "
+            "'slow' marker:",
+        )
+        for nodeid in worlds:
+            terminalreporter.write_line(f"  {nodeid}")
 
 
 def pytest_sessionfinish(session, exitstatus):
-    if offenders(_records, budget_s()):
+    if offenders(_records, budget_s()) or world_offenders(_world_records):
         session.exitstatus = EXIT_OFFENDERS
 
 

@@ -29,6 +29,7 @@ from tpudist.store import TCPStore
 from tpudist.amp import Policy, policy_for, skip_nonfinite
 from tpudist.optim import make_optimizer, run_schedule, warmup_cosine
 from tpudist.telemetry import TelemetryConfig
+from tpudist.resilience import Preempted
 
 __version__ = "0.1.0"
 
@@ -49,5 +50,6 @@ __all__ = [
     "run_schedule",
     "warmup_cosine",
     "TelemetryConfig",
+    "Preempted",
     "__version__",
 ]

@@ -53,7 +53,15 @@ class Checkpointer:
     def save(self, state: TrainState, step: int | None = None,
              wait: bool = False) -> bool:
         """Persist ``state`` (async by default). Returns False if this step
-        is already saved."""
+        is already saved.
+
+        ``wait=True`` is the EMERGENCY-SAVE contract
+        (``tpudist.resilience``): it blocks until the checkpoint — and any
+        earlier in-flight async save — is durable on disk, which is what
+        fit()'s graceful-preemption path calls before exiting 75. The
+        supervisor may relaunch the moment this process dies; only a
+        synchronous save guarantees the next generation finds the step it
+        was promised."""
         if step is None:
             step = int(state.step)
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state))

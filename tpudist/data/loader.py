@@ -120,7 +120,8 @@ class DataLoader(SampledLoader):
         return batch
 
 
-def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
+def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None,
+                     stop_check=None, stop_poll_s: float = 0.5):
     """Stage host batches onto the device mesh ``depth`` steps ahead.
 
     The replacement for pinned-memory + synchronous ``.cuda()``: device_put
@@ -130,6 +131,15 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
 
     ``stage_fn`` overrides the default flat-batch sharding (used e.g. by the
     grad-accumulation path, which folds a microbatch dim in first).
+
+    ``stop_check`` (polled every ``stop_poll_s`` while the consumer waits
+    on the producer): returning True ends the stream EARLY — already
+    staged batches still drain, then the generator finishes as if the
+    epoch ended. fit() passes its preemption flag here: a SIGTERM landing
+    while the input pipeline is STALLED (a wedged data source, realistic
+    at exactly preemption time) must still reach the graceful
+    emergency-checkpoint path instead of blocking in a timeout-less wait
+    until the scheduler's SIGKILL.
     """
     from tpudist.mesh import shard_batch
 
@@ -165,10 +175,14 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, stage_fn=None):
         """Next host item, the producer's error object, or DONE. Producer
         errors are RETURNED (so the consumer can defer them behind staged
         batches); exceptions raised here — e.g. a KeyboardInterrupt during
-        the wait — propagate immediately."""
+        the wait — propagate immediately. With ``stop_check``, a stalled
+        wait polls the flag and reports DONE on a stop — the producer
+        thread is retired by the generator's finally."""
         with lock:
             while not host_q:
-                lock.wait()
+                if stop_check is not None and stop_check():
+                    return DONE
+                lock.wait(None if stop_check is None else stop_poll_s)
             item = host_q.popleft()
             lock.notify_all()
         return item

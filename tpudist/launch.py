@@ -21,6 +21,17 @@ chips (so ``--nproc_per_node`` defaults to 1 and ``--nnode/--node_rank``
 describe hosts); ``--nproc_per_node>1`` exists for local CPU emulation of a
 multi-process world (each process gets a disjoint slice of fake CPU devices
 via ``--emulate-devices``).
+
+Beyond the reference's fail-fast, the launcher is a SUPERVISOR
+(``tpudist.resilience.supervisor``): exit codes 75 (preempted) / 76
+(watchdog hang) mean the trainer persisted its state and asked to be
+relaunched — those restart promptly regardless of ``--max_restarts``,
+bounded by the ``--restart_budget``/``--restart_window`` rolling window;
+any other non-zero exit is a crash, restarted only within
+``--max_restarts`` attempts with exponential backoff + jitter. Every
+generation gets ``TPUDIST_RESTART_GENERATION`` exported so telemetry is
+attributable across the lives of the job. The preemption recipe:
+docs/MULTIHOST.md "Surviving preemption".
 """
 
 from __future__ import annotations
@@ -30,6 +41,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -53,10 +65,44 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the script as an executable instead of `python script`")
     p.add_argument(
         "--max_restarts", type=int, default=0,
-        help="relaunch this node's processes up to N times after a non-zero "
-        "exit — elastic-style recovery beyond the reference's fail-fast "
+        help="relaunch this node's processes up to N times after a CRASH "
+        "(any non-zero exit other than the restartable codes 75/76) — "
+        "elastic-style recovery beyond the reference's fail-fast "
         "(SURVEY.md §5); pair with the trainer's --checkpoint_dir so the "
-        "relaunched run resumes from the last checkpoint. 0 = fail fast.",
+        "relaunched run resumes from the last checkpoint. 0 = fail fast "
+        "on crashes. Restartable exits (preempted=75, watchdog hang=76) "
+        "restart regardless, bounded only by the restart budget.",
+    )
+    p.add_argument(
+        "--restart_budget", type=int, default=10,
+        help="circuit breaker: at most N restarts (of any kind) per "
+        "--restart_window seconds, then give up with the world's exit "
+        "code — a deterministically-crashing or instantly-re-preempted "
+        "job exhausts its budget instead of spinning. 0 = unlimited.",
+    )
+    p.add_argument(
+        "--restart_window", type=float, default=600.0,
+        help="the rolling window (seconds) the restart budget counts in",
+    )
+    p.add_argument(
+        "--backoff_base", type=float, default=1.0,
+        help="first crash-restart delay (seconds); doubles per consecutive "
+        "crash up to --backoff_max, with ±50%% jitter so a fleet of "
+        "launchers never stampedes the rendezvous port in lockstep. "
+        "Restartable exits (75/76) relaunch without backoff.",
+    )
+    p.add_argument(
+        "--backoff_max", type=float, default=60.0,
+        help="crash-restart backoff ceiling (seconds, pre-jitter)",
+    )
+    p.add_argument(
+        "--term_grace", type=float, default=30.0,
+        help="seconds to wait for a terminated child to exit before "
+        "SIGKILL. Also the voluntary-exit window granted to siblings when "
+        "a rank exits with a restartable code: they likely received the "
+        "same preemption signal and are mid-emergency-checkpoint — a "
+        "SIGTERM now would escalate past their graceful handler. Raise "
+        "it for models whose emergency save takes longer.",
     )
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -64,13 +110,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from tpudist.resilience.supervisor import (
+        BackoffPolicy, RestartBudget, Supervisor,
+    )
+
     args = build_parser().parse_args(argv)
-    attempt = 0
     # one handler for the launcher's whole life, closing over the CURRENT
     # generation's procs: a SIGTERM landing between generations (previous
     # world dead, next one mid-spawn) still sets the stop flag and
     # terminates whatever is alive, so the restart loop can never spawn or
-    # keep a world past an operator stop
+    # keep a world past an operator stop. The children's SIGTERM is their
+    # graceful-preemption trigger (tpudist.resilience.preempt) — they get
+    # --term_grace to write their emergency checkpoints before any KILL.
     stop = {"terminated": False, "procs": []}
 
     def _kill(signum, frame):
@@ -80,24 +131,56 @@ def main(argv: list[str] | None = None) -> int:
                 p.terminate()
 
     signal.signal(signal.SIGTERM, _kill)
-    while True:
-        rc = _run_world(args, stop)
-        # never auto-restart over an operator stop: 130 = Ctrl-C, and a
-        # SIGTERM delivered to the launcher itself (scheduler preemption /
-        # supervisor shutdown) sets stop["terminated"] — the children's
-        # resulting non-zero exits are launcher-initiated, not failures
-        if rc == 0 or rc == 130 or stop["terminated"] or attempt >= args.max_restarts:
-            return rc
-        attempt += 1
-        print(
-            f"tpudist.launch: world exited rc={rc}; restarting "
-            f"({attempt}/{args.max_restarts})",
-            file=sys.stderr,
-        )
+    sup = Supervisor(
+        lambda generation: _run_world(args, stop, generation=generation),
+        max_restarts=args.max_restarts,
+        budget=RestartBudget(args.restart_budget, args.restart_window),
+        backoff=BackoffPolicy(args.backoff_base, args.backoff_max),
+        stop=lambda: stop["terminated"],
+    )
+    return sup.run()
 
 
-def _run_world(args, stop: dict | None = None) -> int:
-    """Spawn and supervise one generation of this node's processes."""
+def _drain_world(procs: list[subprocess.Popen], grace_s: float, *,
+                 voluntary_s: float = 0.0) -> None:
+    """Reap EVERY child before returning — the launcher must never hand
+    the next restart generation a world whose predecessors still hold
+    ``MASTER_PORT`` or the checkpoint-dir locks (a terminated child is
+    not a dead child until ``wait()`` says so).
+
+    ``voluntary_s`` first waits that long for children to exit on their
+    own with NO signal sent: a preempted world's siblings received the
+    same SIGTERM the exiting rank did and are mid-emergency-checkpoint —
+    terminating them now would escalate past their graceful handler and
+    lose exactly the state the preemption path exists to save. Then the
+    sweep: SIGTERM, up to ``grace_s`` to finish, SIGKILL stragglers, and
+    an unconditional ``wait()`` on every child.
+    """
+    if voluntary_s > 0:
+        deadline = time.monotonic() + voluntary_s
+        while (any(p.poll() is None for p in procs)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + max(grace_s, 0.0)
+    while (any(p.poll() is None for p in procs)
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait()
+
+
+def _run_world(args, stop: dict | None = None, generation: int = 0) -> int:
+    """Spawn, supervise, and fully REAP one generation of this node's
+    processes (every exit path drains the world — no child outlives the
+    return)."""
+    from tpudist.resilience.exitcodes import GENERATION_ENV, is_restartable
+
     if stop is None:
         stop = {"terminated": False, "procs": []}
     world_size = args.nnode * args.nproc_per_node
@@ -115,6 +198,9 @@ def _run_world(args, stop: dict | None = None) -> int:
             WORLD_SIZE=str(world_size),
             LOCAL_RANK=str(local_rank),
         )
+        # which life of the job this is: telemetry stamps heartbeats and
+        # the run report with it, goodput aggregates across it
+        env[GENERATION_ENV] = str(generation)
         if args.emulate_devices:
             env["JAX_PLATFORMS"] = "cpu"
             env["TPUDIST_FORCE_CPU"] = "1"
@@ -128,20 +214,24 @@ def _run_world(args, stop: dict | None = None) -> int:
 
     rc = 0
     try:
-        # poll all children: the first non-zero exit terminates the rest so
-        # a dead rank can't leave the world hung in a collective
-        # (SURVEY.md §5 failure detection: static world, fail-fast)
-        import time as _time
-
+        # poll all children: the first non-zero exit drains the rest so a
+        # dead rank can't leave the world hung in a collective (SURVEY.md
+        # §5 failure detection: static world, fail-fast) — and the drain
+        # WAITS on every terminated child, so the next restart generation
+        # can never race still-dying processes for MASTER_PORT or the
+        # checkpoint-dir locks
         live = list(procs)
         while live:
             if stop["terminated"]:
-                # operator stop may have raced a mid-Popen child past the
-                # handler's terminate sweep; re-sweep here so no child
-                # outlives the stop
-                for q in live:
-                    if q.poll() is None:
-                        q.terminate()
+                # operator stop: the signal handler already SIGTERM'd the
+                # world (the children's graceful trigger); grant the grace
+                # window before the kill sweep, and reap everything
+                _drain_world(procs, args.term_grace,
+                             voluntary_s=args.term_grace)
+                for p in procs:
+                    if p.returncode and rc == 0:
+                        rc = p.returncode
+                return rc
             for p in list(live):
                 code = p.poll()
                 if code is None:
@@ -149,16 +239,21 @@ def _run_world(args, stop: dict | None = None) -> int:
                 live.remove(p)
                 if code != 0 and rc == 0:
                     rc = code
-                    for q in live:
-                        q.terminate()
+            if rc != 0 and live:
+                # restartable exit: the siblings most likely trapped the
+                # same preemption signal and are writing their own
+                # emergency checkpoints — give them the voluntary window
+                # before any terminate. A crash exit keeps fail-fast:
+                # terminate immediately (grace, then kill).
+                _drain_world(
+                    live, args.term_grace,
+                    voluntary_s=args.term_grace if is_restartable(rc) else 0.0,
+                )
+                live = []
             if live:
-                _time.sleep(0.2)
+                time.sleep(0.2)
     except KeyboardInterrupt:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            p.wait()
+        _drain_world(procs, args.term_grace)
         rc = 130
     return rc
 

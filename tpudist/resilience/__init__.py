@@ -1,0 +1,75 @@
+"""Resilience: the layer that turns failures into bounded-cost events.
+
+tpudist can *detect* sick jobs (the run-health layer) and *persist* state
+(the Orbax checkpointer); this package connects detection to action so a
+preemption, a hang, or a crash costs a bounded amount of work instead of
+the whole run:
+
+- :mod:`~tpudist.resilience.exitcodes` — the trainer↔supervisor exit-code
+  contract (75 = preempted/resume, 76 = watchdog hang, else crash);
+- :mod:`~tpudist.resilience.preempt` — SIGTERM/SIGINT trapped as a
+  signal-safe flag; ``fit()`` finishes the in-flight step, writes a
+  synchronous emergency checkpoint, flushes the run report with
+  ``exit_reason="preempted"``, and raises :class:`Preempted` (exit 75);
+- :mod:`~tpudist.resilience.supervisor` — restart policy for
+  ``tpudist.launch``: restartable-code fast path, exponential backoff
+  with jitter for crashes, a rolling restart-budget window, and the
+  ``TPUDIST_RESTART_GENERATION`` counter;
+- :mod:`~tpudist.resilience.goodput` — wall-time partitioning (productive
+  step time vs compile/checkpoint/data-wait/restart overhead), aggregated
+  across generations into the run report's ``goodput`` section;
+- :mod:`~tpudist.resilience.chaos` — deterministic crash/hang/SIGTERM
+  injection (``main.py --chaos``, the recovery tests, the bench's
+  ``gpt2_124m_preempt_recovery_s`` leg).
+
+Operational recipe: docs/MULTIHOST.md "Surviving preemption".
+"""
+
+from tpudist.resilience.chaos import (
+    ChaosCrash,
+    ChaosInjector,
+    ChaosSpec,
+    make_injector,
+)
+from tpudist.resilience.exitcodes import (
+    EXIT_CRASH,
+    EXIT_HANG,
+    EXIT_INTERRUPT,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    GENERATION_ENV,
+    RESTARTABLE,
+    is_restartable,
+    restart_generation,
+)
+from tpudist.resilience.goodput import GoodputTracker
+from tpudist.resilience.preempt import Preempted, PreemptionGuard
+from tpudist.resilience.supervisor import (
+    BackoffPolicy,
+    RestartBudget,
+    Supervisor,
+    classify,
+)
+
+__all__ = [
+    "EXIT_OK",
+    "EXIT_CRASH",
+    "EXIT_PREEMPTED",
+    "EXIT_HANG",
+    "EXIT_INTERRUPT",
+    "RESTARTABLE",
+    "GENERATION_ENV",
+    "is_restartable",
+    "restart_generation",
+    "Preempted",
+    "PreemptionGuard",
+    "BackoffPolicy",
+    "RestartBudget",
+    "Supervisor",
+    "classify",
+    "GoodputTracker",
+    "ChaosCrash",
+    "ChaosSpec",
+    "ChaosInjector",
+    "make_injector",
+]

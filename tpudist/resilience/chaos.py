@@ -1,0 +1,131 @@
+"""Fault injection: deterministic crash / hang / SIGTERM at a chosen step.
+
+The recovery path deserves the same adversarial testing the detection
+path got (PR 7's simulated hangs and injected stragglers): this harness
+injects the three failure shapes the resilience layer exists for, at an
+exact step boundary, identically from unit tests, the 2-process emulated
+world, ``main.py --chaos``, and the bench's recovery leg.
+
+Spec grammar (``ChaosSpec.parse``)::
+
+    <kind>[:<seconds>]@<step>[@<generation>]
+
+    crash@12        raise ChaosCrash after step 12 completes (gen 0 only)
+    sigterm@12      SIGTERM self after step 12 (the preemption drill)
+    hang:600@12     block the loop 600 s after step 12 (watchdog food)
+    crash@5@*       crash at step 5 in EVERY generation — the
+                    deterministic-crash loop that must exhaust the
+                    supervisor's restart budget, not spin
+
+The generation field defaults to ``0``: an injected incident happens once,
+in the first life of the job, and the relaunched generation — which
+resumes AT the trigger step — must not re-fire it. ``*`` fires in every
+generation (deterministic bugs don't go away on restart). ``fit()`` calls
+:meth:`ChaosInjector.maybe_fire` with the number of COMPLETED steps at
+each loop boundary, before dispatching the next step — so ``sigterm@k``
+yields an emergency checkpoint at exactly step ``k`` and a resume at
+``k+1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+
+from tpudist.resilience.exitcodes import restart_generation
+
+__all__ = ["ChaosCrash", "ChaosSpec", "ChaosInjector", "make_injector"]
+
+KINDS = ("crash", "hang", "sigterm")
+DEFAULT_HANG_S = 3600.0
+
+
+class ChaosCrash(RuntimeError):
+    """The injected deterministic crash — a real exception through the
+    real crash path (fit's handler, the run report's ``crashed:`` status,
+    the launcher's non-restartable exit)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    kind: str
+    step: int
+    duration_s: float = DEFAULT_HANG_S
+    generation: int | None = 0  # None = every generation ("*")
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosSpec":
+        parts = str(spec).strip().split("@")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"chaos spec {spec!r} is not '<kind>[:<seconds>]@<step>"
+                f"[@<generation>|@*]'"
+            )
+        head, step_s = parts[0], parts[1]
+        kind, _, dur = head.partition(":")
+        if kind not in KINDS:
+            raise ValueError(
+                f"chaos kind {kind!r} not in {KINDS} (spec {spec!r})"
+            )
+        duration = float(dur) if dur else DEFAULT_HANG_S
+        if dur and kind != "hang":
+            raise ValueError(
+                f"only 'hang' takes a duration (spec {spec!r})"
+            )
+        gen: int | None = 0
+        if len(parts) == 3:
+            gen = None if parts[2] == "*" else int(parts[2])
+        return cls(kind=kind, step=int(step_s), duration_s=duration,
+                   generation=gen)
+
+
+class ChaosInjector:
+    """One-shot trigger bound to this process's restart generation."""
+
+    def __init__(self, spec: ChaosSpec, *, generation: int | None = None,
+                 sleep=time.sleep, kill=os.kill):
+        self.spec = spec
+        self.generation = (
+            restart_generation() if generation is None else int(generation)
+        )
+        self.fired = False
+        self._sleep = sleep
+        self._kill = kill
+
+    def maybe_fire(self, completed_step: int) -> bool:
+        """Fire once when ``completed_step`` reaches the spec's step in an
+        armed generation. Returns True if it fired (crash raises
+        instead)."""
+        if self.fired or completed_step < self.spec.step:
+            return False
+        if (self.spec.generation is not None
+                and self.generation != self.spec.generation):
+            return False
+        self.fired = True
+        if self.spec.kind == "crash":
+            raise ChaosCrash(
+                f"chaos: injected crash after step {completed_step} "
+                f"(generation {self.generation})"
+            )
+        if self.spec.kind == "hang":
+            self._sleep(self.spec.duration_s)
+            return True
+        # sigterm: the preemption drill — the signal lands on this very
+        # process; with fit()'s PreemptionGuard installed the flag is set
+        # before the next step dispatches
+        self._kill(os.getpid(), signal.SIGTERM)
+        return True
+
+
+def make_injector(chaos) -> ChaosInjector | None:
+    """``fit()``'s coercion point: None | spec string | ChaosSpec |
+    ready-made ChaosInjector."""
+    if chaos is None:
+        return None
+    if isinstance(chaos, ChaosInjector):
+        return chaos
+    if isinstance(chaos, ChaosSpec):
+        return ChaosInjector(chaos)
+    return ChaosInjector(ChaosSpec.parse(chaos))

@@ -1,0 +1,65 @@
+"""The process exit-code contract between the trainer and the supervisor.
+
+On TPU pods the dominant interrupts are *planned* — preemptions and
+maintenance events delivered as SIGTERM with a grace window — and the one
+bit the dying process can reliably hand its supervisor is the exit code.
+This module is the contract's single home: the trainer (``tpudist.train
+.fit`` via :mod:`tpudist.resilience.preempt`, the hang watchdog via
+``TelemetryConfig(hang_action="exit")``) exits with one of these codes,
+and the supervisor (``tpudist.launch`` → :mod:`tpudist.resilience
+.supervisor`) restarts ONLY the codes that say "resume me":
+
+- ``EXIT_PREEMPTED`` (75, BSD's EX_TEMPFAIL): the run trapped
+  SIGTERM/SIGINT, finished its in-flight step, wrote a synchronous
+  emergency checkpoint, and exited on purpose — relaunch and resume.
+- ``EXIT_HANG`` (76, EX_PROTOCOL): the hang watchdog tripped, the crash
+  forensics are on disk, and ``hang_action="exit"`` terminated the wedged
+  process — relaunch from the last checkpoint.
+- ``EXIT_INTERRUPT`` (130, 128+SIGINT): operator Ctrl-C at the launcher —
+  never restarted.
+- anything else non-zero is a crash: restarted only within the legacy
+  ``--max_restarts`` attempt budget (with backoff), never on the
+  restartable fast path.
+
+75/76 sit in the 64..78 sysexits range, clear of shell conventions
+(126/127), signal deaths (128+N), and ordinary ``sys.exit(1)`` crashes —
+a launcher that predates this contract treats them as generic failures
+and still recovers via ``--max_restarts``, just without the
+backoff/budget discipline.
+"""
+
+from __future__ import annotations
+
+import os
+
+EXIT_OK = 0
+EXIT_CRASH = 1
+EXIT_PREEMPTED = 75
+EXIT_HANG = 76
+EXIT_INTERRUPT = 130
+
+#: codes whose meaning is "state is durable, relaunch me" — the trainer
+#: exited deliberately after persisting what it could
+RESTARTABLE = frozenset({EXIT_PREEMPTED, EXIT_HANG})
+
+#: the supervisor exports each world's generation under this name; rank
+#: telemetry reads it so heartbeats/reports are attributable across the
+#: lives of one logical job (0 = first launch)
+GENERATION_ENV = "TPUDIST_RESTART_GENERATION"
+
+
+def is_restartable(rc: int) -> bool:
+    """True iff ``rc`` is a deliberate checkpoint-and-exit code (signal
+    deaths arrive as negative codes from ``Popen`` and are crashes)."""
+    return rc in RESTARTABLE
+
+
+def restart_generation(environ=None) -> int:
+    """This process's restart generation (``TPUDIST_RESTART_GENERATION``,
+    default 0). Tolerant of garbage values: telemetry must not die on a
+    malformed environment."""
+    raw = (environ or os.environ).get(GENERATION_ENV, "0")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return 0

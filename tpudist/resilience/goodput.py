@@ -1,0 +1,175 @@
+"""Goodput accounting: where did the wall time of this job's life go?
+
+Under preemption the headline metric is not tokens/sec but **goodput** —
+the fraction of wall time spent making forward progress once compile,
+checkpoint save/restore, data stalls, and restart/resume overhead are
+paid (the operational regime of the TPUv4 pjit experience reports:
+recovery time, not peak rate, determines useful throughput at pod
+scale). :class:`GoodputTracker` partitions one ``fit()`` call's wall time
+into disjoint components and aggregates them ACROSS restart generations
+through the ``{job}_report.json`` each generation leaves behind:
+
+- ``bringup_s`` — fit entry → first loop iteration (state init, replica
+  verification, telemetry bring-up), minus the restore below;
+- ``restore_s`` — checkpoint restore (the resume read);
+- ``compile_s`` — the first loop iteration wall time (jit traces and
+  compiles synchronously on first call, so iteration 1 *is* the compile,
+  plus one ordinary step — an upper bound, noted not subtracted);
+- ``data_wait_s`` — seconds the loop blocked on the batch iterator
+  (steady-state iterations only; iteration 1's wait is inside
+  ``compile_s``);
+- ``checkpoint_s`` — seconds blocked in checkpoint saves, including the
+  synchronous emergency save (also reported separately as
+  ``emergency_save_s``, a subset of ``checkpoint_s``);
+- ``productive_step_s`` — the residual: total minus everything above.
+  Computing productive time as the residual is what makes the components
+  sum to the generation's wall time *exactly* (the report's acceptance
+  contract), and it is the honest definition — any second not spent on
+  an identified overhead was available to the step pipeline.
+
+Cross-generation: each generation's summary carries a ``generations``
+list (its own entry appended to the predecessors' — loaded from the
+previous report via :meth:`GoodputTracker.load_previous`) and a
+``cumulative`` block whose ``restart_overhead_s`` prices recovery: the
+inter-generation wall gaps (supervisor backoff + process spawn) plus
+every resumed generation's bring-up/restore/compile plus every emergency
+save. That number is what the bench leg
+``gpt2_124m_preempt_recovery_s`` records.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Callable
+
+__all__ = ["GoodputTracker"]
+
+# the disjoint partition of one generation's wall time; productive is the
+# residual so the sum is exact by construction
+COMPONENTS = (
+    "bringup_s",
+    "restore_s",
+    "compile_s",
+    "data_wait_s",
+    "checkpoint_s",
+)
+
+
+class GoodputTracker:
+    def __init__(self, *, generation: int = 0,
+                 clock: Callable[[], float] = time.perf_counter,
+                 wall: Callable[[], float] = time.time):
+        self.generation = int(generation)
+        self._clock = clock
+        self._wall = wall
+        self._t0 = clock()
+        self.start_wall = wall()
+        self._parts = {k: 0.0 for k in COMPONENTS}
+        self.emergency_save_s = 0.0
+        self.steps = 0
+        self._loop_t: float | None = None
+        self._first_step_done = False
+        self._prior: list[dict] = []
+
+    # -- wiring ------------------------------------------------------------
+
+    def load_previous(self, report_path: str | Path) -> None:
+        """Carry forward the previous generations' entries from the report
+        the last life of this job wrote (same job_id, same log_dir — the
+        sink's append-mode precedent). Malformed/absent files are ignored:
+        goodput is accounting, never a crash source."""
+        try:
+            report = json.loads(Path(report_path).read_text())
+            gens = report["goodput"]["generations"]
+            self._prior = [dict(g) for g in gens if isinstance(g, dict)]
+        except Exception:
+            self._prior = []
+
+    def add(self, component: str, seconds: float) -> None:
+        self._parts[component] += max(float(seconds), 0.0)
+
+    def add_emergency_save(self, seconds: float) -> None:
+        """The preemption path's synchronous save: counted inside
+        ``checkpoint_s`` (the partition stays disjoint) and surfaced
+        separately — it is the per-incident recovery cost."""
+        self.add("checkpoint_s", seconds)
+        self.emergency_save_s += max(float(seconds), 0.0)
+
+    def loop_started(self) -> None:
+        """The epoch loop is about to run: everything so far that isn't
+        already attributed (restore, early checkpoint work) is bring-up."""
+        self._loop_t = self._clock()
+        self._parts["bringup_s"] = max(
+            (self._loop_t - self._t0)
+            - self._parts["restore_s"] - self._parts["checkpoint_s"],
+            0.0,
+        )
+
+    def step_boundary(self, data_wait_s: float = 0.0) -> None:
+        """Called once per completed loop iteration. The first iteration
+        is attributed whole to ``compile_s`` (jit compiles synchronously
+        inside it); later iterations contribute their measured data
+        wait."""
+        self.steps += 1
+        now = self._clock()
+        if not self._first_step_done:
+            self._first_step_done = True
+            base = self._loop_t if self._loop_t is not None else self._t0
+            self._parts["compile_s"] = max(now - base, 0.0)
+            return
+        self.add("data_wait_s", data_wait_s)
+
+    # -- report ------------------------------------------------------------
+
+    def _entry(self, exit_reason: str) -> dict:
+        total = self._clock() - self._t0
+        overhead = sum(self._parts.values())
+        entry = {
+            "generation": self.generation,
+            "exit_reason": exit_reason,
+            "total_s": round(total, 6),
+            "productive_step_s": round(max(total - overhead, 0.0), 6),
+            **{k: round(v, 6) for k, v in self._parts.items()},
+            "emergency_save_s": round(self.emergency_save_s, 6),
+            "steps": self.steps,
+            "start_wall": round(self.start_wall, 3),
+            "end_wall": round(self._wall(), 3),
+        }
+        return entry
+
+    def summary(self, exit_reason: str = "completed") -> dict:
+        """The report's ``goodput`` section. Safe to call repeatedly (the
+        watchdog snapshots mid-run, finish() writes the final one): each
+        call recomputes from live counters without mutating history."""
+        entry = self._entry(exit_reason)
+        gens = self._prior + [entry]
+        gaps = [
+            max(b.get("start_wall", 0.0) - a.get("end_wall", 0.0), 0.0)
+            for a, b in zip(gens, gens[1:])
+        ]
+        resumed = gens[1:]
+        restart_overhead = (
+            sum(gaps)
+            + sum(g.get("bringup_s", 0.0) + g.get("restore_s", 0.0)
+                  + g.get("compile_s", 0.0) for g in resumed)
+            + sum(g.get("emergency_save_s", 0.0) for g in gens)
+        )
+        total = sum(g.get("total_s", 0.0) for g in gens) + sum(gaps)
+        productive = sum(g.get("productive_step_s", 0.0) for g in gens)
+        out = dict(entry)
+        out["productive_frac"] = round(
+            entry["productive_step_s"] / max(entry["total_s"], 1e-9), 6
+        )
+        out["generations"] = gens
+        out["cumulative"] = {
+            "wall_s": round(total, 6),
+            "productive_step_s": round(productive, 6),
+            "restart_gap_s": round(sum(gaps), 6),
+            "restart_overhead_s": round(restart_overhead, 6),
+            "productive_frac": round(
+                productive / total if total > 0 else 0.0, 6
+            ),
+        }
+        return out

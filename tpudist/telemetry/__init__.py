@@ -93,6 +93,14 @@ class TelemetryConfig:
     (``None`` = one unbounded file, the pre-rotation contract);
     :func:`tpudist.telemetry.health.health_config` is the one-call
     production preset (``main.py --health``).
+
+    ``hang_action`` escalates the watchdog: ``"report"`` (default, the
+    pre-resilience behavior) writes the forensics and lets a resolving
+    stall finish the run; ``"exit"`` additionally terminates the process
+    with :data:`tpudist.resilience.EXIT_HANG` (76) AFTER the crash
+    file/report/row are on disk — the restartable code
+    ``tpudist.launch``'s supervisor relaunches from the last checkpoint,
+    closing the detection → forensics → recovery loop.
     """
 
     health_metrics: bool = True
@@ -115,6 +123,7 @@ class TelemetryConfig:
     straggler_patience: int = 3
     divergence_every: int = 0
     hang_timeout_s: float | None = None
+    hang_action: str = "report"
     run_report: bool = True
     jsonl_max_bytes: int | None = None
 
@@ -447,6 +456,16 @@ class Telemetry:
         # by build_telemetry when any health knob (or the run report) is
         # on; None keeps every health path a no-op
         self.health = None
+        # goodput tracker (tpudist.resilience.goodput), attached by fit();
+        # the run report's `goodput` section reads it. None = no section.
+        self.goodput = None
+        # restart generation (TPUDIST_RESTART_GENERATION, exported by the
+        # supervisor; 0 on a first launch): stamps heartbeat rows and the
+        # run report so streams sharing one append-mode file are
+        # attributable across the lives of the job
+        from tpudist.resilience import restart_generation
+
+        self.generation = restart_generation()
         # heartbeat identity fields: process_index + hostname + a
         # monotonic clock let the cross-process aggregator (and humans)
         # align per-rank timelines — rank alone is ambiguous once
@@ -651,11 +670,15 @@ class Telemetry:
             # identity/clock triple (process_index, host, mono) is
             # appended so per-rank timelines can be aligned (wall clocks
             # skew across hosts; time.monotonic deltas do not)
+            # generation rides AFTER the identity triple — the same
+            # append-only discipline: existing fields byte-identical,
+            # new ones appended (0 on a never-restarted run)
             self.sink.write("heartbeat", step, epoch=epoch,
                             interval_s=round(interval_s, 6),
                             process_index=self.process_index,
                             host=self._host,
-                            mono=round(time.monotonic(), 6))
+                            mono=round(time.monotonic(), 6),
+                            generation=self.generation)
 
         if self.health is not None:
             # host_s is the rank-LOCAL share of the step (input wait +
@@ -713,13 +736,15 @@ class Telemetry:
             self.health.shutdown()
         self.sink.close()
 
-    def finish(self, opt_state=None) -> None:
+    def finish(self, opt_state=None, status: str = "completed") -> None:
         """Final summary row (rank 0): sentry event count and — when the
         optimizer chain carries an ``amp.skip_nonfinite`` wrapper — its
         skip counter (one host fetch, at run end only). With run-health
         on, also drains the delayed aggregation/probe pipelines (all
         ranks — they hold already-dispatched collectives' results) and
-        writes the end-of-run report."""
+        writes the end-of-run report. ``status`` stamps the report
+        (``"preempted"`` from fit's graceful-preemption path — still a
+        clean drain: nothing is hung, the collectives resolve)."""
         skips = None
         if self.rank == 0 and opt_state is not None:
             from tpudist.amp import maybe_skipped_steps
@@ -732,7 +757,7 @@ class Telemetry:
                 optimizer_nonfinite_skips=skips,
             )
         if self.health is not None:
-            self.health.finish(status="completed", optimizer_skips=skips)
+            self.health.finish(status=status, optimizer_skips=skips)
 
     def __enter__(self):
         return self

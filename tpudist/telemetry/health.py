@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import sys
 import threading
 import time
@@ -410,12 +411,23 @@ class RunHealth:
     paths."""
 
     def __init__(self, config, sink, *, job_id: str, log_dir: str,
-                 mesh=None, rank: int = 0, profiler=None, tel=None):
+                 mesh=None, rank: int = 0, profiler=None, tel=None,
+                 exit_fn: Callable[[int], None] | None = None):
         self.config = config
         self.sink = sink
         self.job_id = job_id
         self.rank = rank
         self.profiler = profiler
+        # hang_action="exit" escalation: os._exit, injectable for tests
+        # (sys.exit from the watchdog's daemon thread would only kill that
+        # thread — the hung main thread is exactly what cannot be asked
+        # to exit cleanly)
+        self._exit = exit_fn if exit_fn is not None else os._exit
+        # bounded pre-exit drain (fit wires the checkpointer's wait here):
+        # exit-76's contract is "relaunch from the last checkpoint", and an
+        # async Orbax commit still writing when os._exit fires would never
+        # finalize — the relaunch would restore an OLDER step than promised
+        self._exit_drain: Callable[[], None] | None = None
         out = Path(log_dir)
         self.report_path = out / f"{job_id}_report.json"
         self.crash_path = out / f"{job_id}_crash_{rank}.json"
@@ -454,6 +466,14 @@ class RunHealth:
         self._tel = tel
 
     # -- per-step drive (main thread) --------------------------------------
+
+    EXIT_DRAIN_TIMEOUT_S = 30.0
+
+    def set_exit_drain(self, fn: Callable[[], None]) -> None:
+        """Register a flush to run (bounded) before a ``hang_action="exit"``
+        termination — fit passes ``Checkpointer.wait`` so an in-flight
+        async save finalizes instead of dying mid-commit."""
+        self._exit_drain = fn
 
     def beat(self, step: int) -> None:
         if self.watchdog is not None:
@@ -523,6 +543,33 @@ class RunHealth:
                  f"{self.crash_path} (docs/MULTIHOST.md: Diagnosing a "
                  "stuck job)",
         )
+        if getattr(self.config, "hang_action", "report") == "exit":
+            # escalation (detection → forensics → recovery): everything
+            # above is on disk, so terminate with the restartable hang
+            # code and let the supervisor relaunch from the last
+            # checkpoint. os._exit, not sys.exit: the main thread is by
+            # definition wedged and atexit/finally would hang behind it.
+            from tpudist.resilience import EXIT_HANG
+
+            if self._exit_drain is not None:
+                # give an in-flight async checkpoint commit a bounded
+                # window to finalize (its writer threads are NOT the hung
+                # ones, usually) — on a side thread with a join timeout,
+                # because when the hang IS the filesystem the drain would
+                # wedge this monitor thread too and the escalation would
+                # never fire
+                drainer = threading.Thread(
+                    target=self._exit_drain, daemon=True,
+                    name="tpudist-exit-drain",
+                )
+                drainer.start()
+                drainer.join(timeout=self.EXIT_DRAIN_TIMEOUT_S)
+            print(
+                f"tpudist: hang watchdog exiting rc={EXIT_HANG} "
+                f"(hang_action='exit'; forensics at {self.crash_path})",
+                file=sys.stderr, flush=True,
+            )
+            self._exit(EXIT_HANG)
 
     # -- report ------------------------------------------------------------
 
@@ -592,6 +639,19 @@ class RunHealth:
             ),
             "telemetry_segments": [str(p) for p in self.sink.segments()],
         }
+        # resilience fields ride APPENDED after the existing keys (the
+        # heartbeat discipline): exit_reason is the operator-facing
+        # disposition ("watchdog" status → "hang" — the condition, not
+        # the detector), generation attributes this report to one life of
+        # the job, goodput is the wall-time partition aggregated across
+        # lives (tpudist.resilience.goodput)
+        exit_reason = "hang" if status == "watchdog" else status
+        report["exit_reason"] = exit_reason
+        report["generation"] = getattr(tel, "generation", 0)
+        goodput = getattr(tel, "goodput", None) if tel is not None else None
+        report["goodput"] = (
+            goodput.summary(exit_reason) if goodput is not None else None
+        )
         report = _strict_json(report)
         self.report_path.write_text(json.dumps(report, indent=1))
         return report

@@ -623,7 +623,10 @@ def fit(
     metrics_logger: MetricsLogger | None = None,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
+    checkpoint_every_s: float | None = None,
     resume: bool = True,
+    preempt: bool | str = "auto",
+    chaos=None,
     init_params=None,
     init_input=None,
 ) -> tuple[TrainState, list[float]]:
@@ -638,6 +641,35 @@ def fit(
     step it stopped at (same epoch, same position in the sampler's
     deterministic order) — a capability the reference lacks entirely
     (SURVEY.md §5: no save/load; crash = start over).
+    ``checkpoint_every_s`` adds a WALL-CLOCK cadence alongside the
+    step-based one: a save triggers when either knob is due. The time
+    knob is what bounds preemption loss on runs with variable step times
+    — "at most N steps of work lost" is meaningless when steps range
+    from 0.3 s to 30 s, "at most M minutes" is the contract operators
+    actually want; the step knob keeps saves aligned to deterministic
+    step numbers for A/B debugging. Interaction when both are set: every
+    save (whichever knob triggered it) resets the time knob's clock, but
+    the step knob stays pinned to absolute multiples of
+    ``checkpoint_every`` — a time-triggered save between multiples does
+    NOT postpone the next step-aligned save (alignment is the step
+    knob's whole point), so the worst-case save frequency is the SUM of
+    the two cadences, not the denser one.
+
+    ``preempt`` (default ``"auto"``) traps SIGTERM/SIGINT as a
+    signal-safe flag checked at step boundaries (``tpudist.resilience``):
+    on trip the in-flight step finishes, a *synchronous* emergency
+    checkpoint is written (when ``checkpoint_dir`` is set), telemetry and
+    the run report flush with ``exit_reason="preempted"``, and
+    :class:`tpudist.resilience.Preempted` is raised — a ``SystemExit``
+    carrying exit code 75, the code ``tpudist.launch`` restarts on.
+    ``"auto"`` installs only where possible (main thread); ``False``
+    keeps the default signal dispositions (the pre-resilience behavior).
+
+    ``chaos`` injects a deterministic fault at a step boundary for
+    recovery testing (``tpudist.resilience.chaos``): a spec string like
+    ``"sigterm@12"`` / ``"crash@5@*"`` / ``"hang:600@8"``, a
+    ``ChaosSpec``, or a prebuilt ``ChaosInjector``. ``None`` (default)
+    injects nothing.
 
     ``telemetry`` (False | True | ``tpudist.telemetry.TelemetryConfig``)
     turns on the observability subsystem (docs/OBSERVABILITY.md): in-step
@@ -789,6 +821,26 @@ def fit(
         # rounding stream) is world-size-bound — resuming a quantized run
         # replicated (or vice versa) must refuse, not silently diverge
         run_meta["reduce"] = step.grad_reducer.method
+    from tpudist.resilience import (
+        GoodputTracker,
+        Preempted,
+        PreemptionGuard,
+        make_injector,
+        restart_generation,
+    )
+
+    chaos_inj = make_injector(chaos)
+    generation = restart_generation()
+    # goodput spans only surface through the run report, so the tracker
+    # rides the telemetry switch; its per-boundary cost is two clock reads
+    gp = GoodputTracker(generation=generation) if tel_cfg is not None else None
+    # SIGTERM/SIGINT → a signal-safe flag checked at step boundaries — the
+    # graceful-preemption path (docs/MULTIHOST.md "Surviving preemption").
+    # Installed here (post state-init, before checkpoint bring-up and the
+    # whole loop — the step compile included): a preemption anywhere past
+    # this line exits 75 after persisting whatever had become restorable.
+    guard = PreemptionGuard(enabled=bool(preempt)).__enter__()
+    preempt_signum = None
     ckpt = None
     start_step = 0
     losses: list[float] = []
@@ -818,7 +870,10 @@ def fit(
                         "resume with the original settings or start a fresh "
                         "checkpoint_dir"
                     )
+                t_restore = time.perf_counter()
                 state = ckpt.restore(like=state)
+                if gp is not None:
+                    gp.add("restore_s", time.perf_counter() - t_restore)
                 start_step = int(state.step)
             ckpt.write_meta(run_meta)
 
@@ -850,6 +905,18 @@ def fit(
                 input_key=input_key, mesh=mesh,
             )
             if tel is not None:
+                tel.goodput = gp
+                if tel.health is not None and ckpt is not None:
+                    # hang_action="exit" tears the process down from the
+                    # watchdog thread: give an in-flight async checkpoint
+                    # commit a bounded chance to finalize first, or the
+                    # relaunch restores an older step than exit-76 promises
+                    tel.health.set_exit_drain(ckpt.wait)
+                if gp is not None and generation and tel.health is not None:
+                    # aggregate goodput across the lives of this job: the
+                    # previous generation left its entries in the report
+                    # this generation will overwrite
+                    gp.load_previous(tel.health.report_path)
                 logger.attach_sink(tel.sink)
                 if step.grad_reducer is not None:
                     # one-time comm accounting + a measured standalone
@@ -891,6 +958,9 @@ def fit(
 
             global_step = start_step
             logger.start_timer()
+            if gp is not None:
+                gp.loop_started()
+            last_save_t = time.monotonic()
 
             # one-step-delayed metric resolution: step k's scalars (loss +
             # the in-step health metrics) are FETCHED while step k+1
@@ -939,8 +1009,19 @@ def fit(
                         device_s=device_s,
                     )
 
+            # a SIGTERM that lands while the consumer is BLOCKED on a
+            # stalled input pipeline must still reach the graceful path:
+            # the prefetch wait polls this flag and ends the stream early
+            # (staged batches drain first), and the epoch loop's own check
+            # below then takes the preemption branch
+            stop_check = (
+                (lambda: guard.tripped is not None) if guard.active else None
+            )
             try:
                 for e in range(start_epoch, epochs):
+                    if guard.tripped is not None:
+                        preempt_signum = guard.tripped
+                        break
                     if hasattr(train_loader, "sampler"):
                         train_loader.sampler.set_epoch(e)
                     first_idx = skip_batches if e == start_epoch else 0
@@ -958,13 +1039,27 @@ def fit(
                     staged = prefetch_to_mesh(
                         batches, mesh,
                         depth=prefetch_depth, stage_fn=step.stage,
+                        stop_check=stop_check,
                     )
-                    if breakdown:
+                    if breakdown or gp is not None:
                         # data-wait attribution: seconds this loop blocked
                         # on the prefetch queue (≈0 while the pipeline keeps
-                        # up; → step time when the run is input-bound)
+                        # up; → step time when the run is input-bound).
+                        # Goodput needs the same number even when the
+                        # breakdown rows are off.
                         staged = TimedIterator(staged)
                     for idx, batch in enumerate(staged, start=first_idx):
+                        # step-boundary resilience hooks, BEFORE the next
+                        # dispatch: chaos first (an injected SIGTERM must
+                        # be visible to the guard check in this same
+                        # iteration), then the graceful-preemption flag —
+                        # so the last dispatched step is the one the
+                        # emergency checkpoint persists
+                        if chaos_inj is not None:
+                            chaos_inj.maybe_fire(global_step)
+                        if guard.tripped is not None:
+                            preempt_signum = guard.tripped
+                            break
                         start = time.time()
                         global_step += 1
                         if tel is not None:
@@ -1025,8 +1120,31 @@ def fit(
                         )
                         if mem_every and global_step % mem_every == 0:
                             logger.log_memory(device_memory_stats())
-                        if ckpt and checkpoint_every and global_step % checkpoint_every == 0:
+                        if ckpt is not None and (
+                            (checkpoint_every
+                             and global_step % checkpoint_every == 0)
+                            or (checkpoint_every_s
+                                and time.monotonic() - last_save_t
+                                >= checkpoint_every_s)
+                        ):
+                            t_save = time.perf_counter()
                             ckpt.save(state)
+                            if gp is not None:
+                                gp.add(
+                                    "checkpoint_s",
+                                    time.perf_counter() - t_save,
+                                )
+                            last_save_t = time.monotonic()
+                        if gp is not None:
+                            gp.step_boundary(staged.last_wait_s)
+                    # a trip during a stalled prefetch wait ends the batch
+                    # stream early WITHOUT running the in-loop check —
+                    # re-check here so a last-epoch stall still takes the
+                    # preemption branch instead of reporting "completed"
+                    if preempt_signum is None and guard.tripped is not None:
+                        preempt_signum = guard.tripped
+                    if preempt_signum is not None:
+                        break
             except BaseException as crash_exc:
                 # flush the last completed step before the exception leaves:
                 # the loss history and TSV then end at the step that actually
@@ -1052,19 +1170,42 @@ def fit(
                 if pending is not None:
                     resolve(time.time())
                     pending = None
-                if tel is not None:
+                if preempt_signum is not None:
+                    # graceful preemption: durability FIRST (the grace
+                    # window can expire any second — the emergency
+                    # checkpoint is synchronous, wait=True), then the run
+                    # report with exit_reason="preempted"
+                    if ckpt is not None and global_step > start_step:
+                        t_save = time.perf_counter()
+                        ckpt.save(state, wait=True)
+                        if gp is not None:
+                            gp.add_emergency_save(
+                                time.perf_counter() - t_save
+                            )
+                    if tel is not None:
+                        tel.finish(state.opt_state, status="preempted")
+                elif tel is not None:
                     tel.finish(state.opt_state)
-            if ckpt and global_step > start_step:
+            if ckpt and preempt_signum is None and global_step > start_step:
                 ckpt.save(state)
     finally:
         # closed here, OUTSIDE the logger's context: the logger's __exit__
         # mirrors its TrainTime footer into the sink (dual-sink mode), so
         # the sink must outlive it (shutdown also stops the hang-watchdog
         # thread before the sink goes away)
+        guard.__exit__(None, None, None)
         if tel is not None:
             tel.shutdown()
         if ckpt:
             ckpt.close()
+    if preempt_signum is not None:
+        # everything durable (emergency checkpoint flushed, report
+        # written, sink closed): hand the supervisor its exit code.
+        # Preempted is a SystemExit(75) — scripts exit restartable with
+        # no handler; library callers catch it for .state/.losses (the
+        # checkpoint-less notebook run keeps its trained state)
+        raise Preempted(preempt_signum, global_step,
+                        state=state, losses=losses)
     return state, losses
 
 
