@@ -293,6 +293,61 @@ def test_generate_eos_pads_the_tail():
             assert (out[r, cut + 1:] == 63).all()
 
 
+def test_generate_return_lengths():
+    """return_lengths: each row's length is its first-EOS index + 1 (the
+    EOS token counts), or max_new_tokens when it never stopped — the same
+    per-row retirement rule the serving engine applies (eos_retire)."""
+    model = GPT2(vocab_size=64, max_seq_len=32, hidden_dim=32, depth=1,
+                 num_heads=4)
+    prompt = _tokens(b=3, s=4, seed=31)
+    params = model.init(jax.random.key(31), prompt, train=False)["params"]
+    free = generate(model, params, prompt, 10, temperature=0.0)
+    eos = int(free[0, 4])
+    out, lengths = generate(model, params, prompt, 10, temperature=0.0,
+                            eos_id=eos, pad_id=63, return_lengths=True)
+    assert lengths.shape == (3,) and lengths.dtype == np.int32
+    for r in range(3):
+        hits = np.nonzero(free[r] == eos)[0]
+        want = hits[0] + 1 if hits.size else 10
+        assert lengths[r] == want, r
+        assert (out[r, lengths[r]:] == 63).all()
+    # no eos: every length is max_new_tokens
+    _, full = generate(model, params, prompt, 6, temperature=0.0,
+                       return_lengths=True)
+    np.testing.assert_array_equal(full, [6, 6, 6])
+
+
+def test_generate_bucketed_prompts_share_one_compile():
+    """Prompt lengths 5, 6, 7 land in the length-8 bucket: ONE compiled
+    program serves all three (the anti-recompile contract for repeated
+    generate() calls under varying prompt lengths), and each bucketed run
+    still matches the repeated-full-forward greedy oracle — pinning the
+    pad-then-rewind cursor logic."""
+    from tpudist.generate import _run, bucket_length
+
+    assert [bucket_length(n) for n in (1, 5, 8, 9, 17)] == [8, 8, 8, 16, 32]
+    assert bucket_length(9, cap=12) == 12
+    # a geometry no other test uses: jit caches per (model, shape), and a
+    # warm entry from another test would hide the recompile this pins
+    model = GPT2(vocab_size=48, max_seq_len=32, hidden_dim=32, depth=1,
+                 num_heads=2)
+    params = model.init(
+        jax.random.key(7), jnp.zeros((1, 8), jnp.int32), train=False
+    )["params"]
+    base = _run._cache_size()
+    for p in (5, 6, 7):
+        prompt = _tokens(b=2, s=p, vocab=48, seed=40 + p)
+        out = generate(model, params, prompt, 5, temperature=0.0)
+        seq = prompt
+        for _ in range(5):
+            logits = model.apply({"params": params}, jnp.asarray(seq),
+                                 train=False)
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))[:, None]
+            seq = np.concatenate([seq, nxt.astype(np.int32)], axis=1)
+        np.testing.assert_array_equal(out, seq[:, p:])
+    assert _run._cache_size() == base + 1
+
+
 def test_generate_with_tensor_sharded_params():
     """Decode composes with tensor parallelism: Megatron-sharded params on
     a data x tensor mesh generate the same tokens as replicated params."""

@@ -49,7 +49,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
-                 max_len: int = 0):
+                 max_len: int = 0, positions=None):
         b, s, d = x.shape
         h = self.num_heads
         drop = lambda y: (
@@ -76,7 +76,9 @@ class Block(nn.Module):
                 )
             from tpudist.ops.decode import cached_kv, decode_attention
 
-            keys, values, mask, pos = cached_kv(self, k, v, max_len)
+            keys, values, mask, pos = cached_kv(
+                self, k, v, max_len, positions=positions
+            )
             # one fused Pallas launch per layer per token unless the caller
             # pinned the dense oracle (attn_impl="xla") — decode is
             # launch-bound, not bandwidth-bound (docs/PERF.md §7)
@@ -211,9 +213,17 @@ class GPT2(nn.Module):
         MFU row."""
         return None if self.num_experts > 0 else "gpt2"
 
+    def init_cache(self, batch_size: int):
+        """Zeroed decode KV cache for ``batch_size`` rows — the serving
+        engine's slot-pool allocation hook (``tpudist.serve.slots``); built
+        via ``eval_shape`` so no params materialize."""
+        from tpudist.generate import zero_cache
+
+        return zero_cache(self, batch_size)
+
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 decode: bool = False):
+                 decode: bool = False, positions=None):
         b, s = tokens.shape
         wte = self.param(
             "wte",
@@ -223,7 +233,30 @@ class GPT2(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (self.max_seq_len, self.hidden_dim), jnp.float32
         )
-        if decode:
+        if decode and positions is not None:
+            # slot-pooled decode (tpudist.serve): each row reads its wpe
+            # entry at its OWN per-slot cursor; the scalar counter below is
+            # neither read nor advanced (the engine owns per-slot lengths),
+            # but stays declared so the cache tree matches the scalar path
+            self.variable("cache", "position", lambda: jnp.zeros((), jnp.int32))
+            if s != 1:
+                raise ValueError("per-row-position decode is single-token")
+            positions = jnp.asarray(positions, jnp.int32)
+            overrun = positions + s > self.max_seq_len
+            # probe OVERRUN for tracer-ness, not positions: under jit a
+            # closed-over concrete positions array still yields a traced
+            # comparison (constants lift to tracers inside the trace)
+            if not isinstance(overrun, jax.core.Tracer) and bool(
+                jnp.any(overrun)
+            ):
+                raise ValueError(
+                    f"per-slot decode past max_seq_len {self.max_seq_len} "
+                    f"(positions {positions}); the KV cache and wpe table "
+                    "end there"
+                )
+            pos = jnp.take(wpe, positions, axis=0)[:, None, :]  # [B, 1, d]
+            pos = jnp.where(overrun[:, None, None], jnp.nan, pos)
+        elif decode:
             # learned positions follow the cache cursor, not [0, s); the
             # init trace only creates the counter (no advance)
             initialized = self.has_variable("cache", "position")
@@ -299,7 +332,11 @@ class GPT2(nn.Module):
                     num_experts=self.num_experts if moe_here else 0,
                     moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
                     mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
-                )(x, train, decode, self.max_seq_len)
+                )(x, train, decode, self.max_seq_len,
+                  # only the (remat-free) decode path threads per-slot
+                  # positions; the remat wrapper's static_argnums contract
+                  # stays untouched
+                  **({"positions": positions} if decode else {}))
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head

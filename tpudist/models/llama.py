@@ -36,15 +36,22 @@ from tpudist.parallel.tp import partitioned as _partitioned
 
 def apply_rope(x, *, theta: float = 10000.0, positions=None):
     """Rotary position embedding over ``x: [B, S, H, D]`` (rotate-half
-    convention). Angles in fp32; output in ``x.dtype``."""
+    convention). Angles in fp32; output in ``x.dtype``. ``positions`` is
+    ``[S]`` (shared across the batch) or ``[B, S]`` (per-row absolute
+    positions — slot-pooled decode, where every cache slot sits at its own
+    sequence length)."""
     b, s, h, d = x.shape
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     if positions is None:
         positions = jnp.arange(s, dtype=jnp.float32)
-    angles = positions[:, None] * freqs[None, :]          # [S, half]
-    cos = jnp.cos(angles)[None, :, None, :]               # [1, S, 1, half]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., :, None] * freqs  # [S, half] or [B, S, half]
+    if angles.ndim == 3:
+        cos = jnp.cos(angles)[:, :, None, :]              # [B, S, 1, half]
+        sin = jnp.sin(angles)[:, :, None, :]
+    else:
+        cos = jnp.cos(angles)[None, :, None, :]           # [1, S, 1, half]
+        sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
@@ -67,7 +74,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
-                 max_len: int = 0):
+                 max_len: int = 0, positions=None):
         b, s, d = x.shape
         h, kv = self.num_heads, self.num_kv_heads
         if h % kv:
@@ -98,16 +105,23 @@ class LlamaBlock(nn.Module):
                 )
             from tpudist.ops.decode import cached_kv, decode_attention
 
+            def rope_positions(pos):
+                # scalar cursor: the chunk rows sit at pos..pos+s-1; per-row
+                # cursors ([B], slot-pooled decode): each row at its own
+                # single position
+                if jnp.ndim(pos) == 0:
+                    return (pos + jnp.arange(s)).astype(jnp.float32)
+                return pos[:, None].astype(jnp.float32)  # [B, 1]
+
             def rotate_k(k, v, pos):
-                positions = (pos + jnp.arange(s)).astype(jnp.float32)
                 return apply_rope(k, theta=self.rope_theta,
-                                  positions=positions), v
+                                  positions=rope_positions(pos)), v
 
             keys, values, mask, pos = cached_kv(
-                self, k, v, max_len, pre_update=rotate_k
+                self, k, v, max_len, pre_update=rotate_k, positions=positions
             )
             q = apply_rope(q, theta=self.rope_theta,
-                           positions=(pos + jnp.arange(s)).astype(jnp.float32))
+                           positions=rope_positions(pos))
             # fused path reads grouped K/V heads natively (no repeat in
             # HBM); the dense oracle repeats inside decode_attention
             attn = decode_attention(
@@ -269,9 +283,17 @@ class Llama(nn.Module):
         would miscount routed experts."""
         return None if self.num_experts > 0 else "llama"
 
+    def init_cache(self, batch_size: int):
+        """Zeroed decode KV cache for ``batch_size`` rows — the serving
+        engine's slot-pool allocation hook (``tpudist.serve.slots``); built
+        via ``eval_shape`` so no params materialize."""
+        from tpudist.generate import zero_cache
+
+        return zero_cache(self, batch_size)
+
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 decode: bool = False):
+                 decode: bool = False, positions=None):
         b, s = tokens.shape
         if s > self.max_seq_len:
             raise ValueError(f"sequence {s} exceeds max_seq_len {self.max_seq_len}")
@@ -335,7 +357,10 @@ class Llama(nn.Module):
                     moe_top_k=self.moe_top_k,
                     capacity_factor=self.capacity_factor,
                     name=f"layer_{i}",
-                )(x, train, decode, self.max_seq_len)
+                )(x, train, decode, self.max_seq_len,
+                  # only the (remat-free) decode path threads per-slot
+                  # positions (same contract as GPT-2)
+                  **({"positions": positions} if decode else {}))
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")(x)
         if return_hidden:
             # the chunked-CE path applies the head per sequence chunk so the

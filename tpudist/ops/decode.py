@@ -50,7 +50,7 @@ NEG_INF = float(np.finfo(np.float32).min)
 FUSED_MAX_BATCH = 16
 
 
-def cached_kv(module, k, v, max_len: int, pre_update=None):
+def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None):
     """Append this step's K/V into the module's decode cache.
 
     Must be called inside a flax module's ``__call__`` (it creates
@@ -65,12 +65,23 @@ def cached_kv(module, k, v, max_len: int, pre_update=None):
     step's absolute position — RoPE models rotate keys here so the cache
     holds position-encoded keys.
 
+    ``positions`` switches to slot-pooled decode (``tpudist.serve``): a
+    ``[B]`` int32 vector of PER-ROW absolute positions. Each row's K/V is
+    scattered at its own cursor and the mask is per-row (``slot <= pos_b``)
+    — the shape discipline that lets requests at different sequence
+    lengths share one compiled decode step. Single-token steps only; the
+    module's scalar ``cache_index`` is neither read nor advanced (the
+    engine owns per-slot lengths), but it stays declared so the cache
+    tree's structure is identical in both modes — a jit'd loop can donate
+    the same cache pytree through either path.
+
     Returns ``(keys, values, mask, position)``: the full head-major
     ``[B, H, max_len, dh]`` cache buffers, a ``[1, 1, s, max_len]``
-    attention mask over valid (already-written) slots, and the integer
-    position where this step was written (for RoPE / learned-position
-    lookup). Feed the buffers to :func:`decode_attention` — they are NOT
-    in the models' ``[B, S, H, dh]`` activation layout.
+    (scalar mode) or ``[B, 1, 1, max_len]`` (per-row mode) attention mask
+    over valid (already-written) slots, and the position(s) where this
+    step was written (for RoPE / learned-position lookup). Feed the
+    buffers to :func:`decode_attention` — they are NOT in the models'
+    ``[B, S, H, dh]`` activation layout.
     """
     b, s, h, dh = k.shape
     # the init trace only CREATES the cache (shape/dtype); mutating there
@@ -85,6 +96,32 @@ def cached_kv(module, k, v, max_len: int, pre_update=None):
     ci = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
     )
+    if positions is not None:
+        if s != 1:
+            raise ValueError(
+                f"per-row-position decode is single-token (got chunk {s}); "
+                "prefill chunks go through the scalar-cursor path"
+            )
+        pos = jnp.asarray(positions, jnp.int32)
+        if pos.shape != (b,):
+            raise ValueError(f"positions must be [{b}], got {pos.shape}")
+        if pre_update is not None:
+            k, v = pre_update(k, v, pos)
+        if initialized:
+            # per-row write as a one-hot select, NOT a gather-scatter
+            # (`.at[arange, :, pos, :].set`): XLA updates the select
+            # in-place on the donated buffer and fuses it, while the
+            # scatter blocks the in-place path and copies every layer's
+            # full [B, H, max_len, dh] buffer — measured 24.6 vs 8.9 ms
+            # per 4-layer step at the serving shapes on CPU
+            onehot = (
+                jnp.arange(max_len)[None, :] == pos[:, None]
+            )[:, None, :, None]  # [B, 1, max_len, 1]
+            ck.value = jnp.where(onehot, k.transpose(0, 2, 1, 3), ck.value)
+            cv.value = jnp.where(onehot, v.transpose(0, 2, 1, 3), cv.value)
+        slots = jnp.arange(max_len)[None, None, None, :]
+        mask = slots <= pos[:, None, None, None]  # [B, 1, 1, max_len]
+        return ck.value, cv.value, mask, pos
     pos = ci.value
     if pre_update is not None:
         k, v = pre_update(k, v, pos)
@@ -219,6 +256,10 @@ def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused",
         and q.shape[0] <= FUSED_MAX_BATCH
         and q.shape[2] % keys.shape[1] == 0
         and kv_panel_bytes <= 6 * 1024 * 1024  # ×2 pipeline buffers ≤ ~12 MB
+        # per-row positions (slot-pooled decode, tpudist.serve) take the
+        # dense path: the kernel prefetches ONE scalar write cursor, and
+        # the serving batch sits above the fused crossover anyway
+        and jnp.ndim(pos) == 0
     )
     if impl == "fused" and fused_ok:
         return _fused_decode_attention(q, keys, values, pos)

@@ -1,0 +1,53 @@
+"""Serving subsystem: continuous-batching inference over the decode path.
+
+The training side (PRs 3–8) made the framework fast and resilient; this
+package opens the INFERENCE workload the north star names ("serves heavy
+traffic from millions of users"). The static ``tpudist.generate`` path —
+one jit program, batch-at-once — cannot admit, stream, or retire requests
+independently; under real mixed-length arrivals its batch assembly and
+longest-row decode dominate latency and waste throughput. The engine here
+keeps the decode batch full instead:
+
+- :mod:`tpudist.serve.slots` — slot-pooled KV cache: one pre-allocated
+  ``[max_slots, ...]`` cache with per-slot cursors/masks; requests join
+  and leave between decode steps with zero recompiles.
+- :mod:`tpudist.serve.prefill` — chunked prefill compiled at a small set
+  of power-of-two bucket lengths, writing prefix K/V into a free slot.
+- :mod:`tpudist.serve.engine` — the scheduler: FIFO admission control,
+  per-slot sampling/stop params, one compiled masked decode step over the
+  full slot batch, per-step streaming delivery.
+- :mod:`tpudist.serve.stats` — TTFT/TPOT percentiles, queue depth, slot
+  utilization, tokens/s as ``serve`` JSONL rows through the telemetry
+  sink (docs/OBSERVABILITY.md; architecture in docs/SERVING.md).
+
+Quick start::
+
+    from tpudist.serve import ServeEngine
+    engine = ServeEngine(model, params, max_slots=8,
+                         on_token=lambda ev: print(ev.request_id, ev.token))
+    engine.submit(prompt_ids, max_new_tokens=64, temperature=0.7, top_k=50)
+    results = engine.run()   # or: for ev in engine.events(): ...
+"""
+
+from tpudist.serve.engine import (
+    NO_EOS,
+    QueueFull,
+    Request,
+    ServeEngine,
+    TokenEvent,
+)
+from tpudist.serve.prefill import Prefiller
+from tpudist.serve.slots import SlotPool, write_slot
+from tpudist.serve.stats import ServeStats
+
+__all__ = [
+    "ServeEngine",
+    "Request",
+    "TokenEvent",
+    "QueueFull",
+    "NO_EOS",
+    "Prefiller",
+    "SlotPool",
+    "write_slot",
+    "ServeStats",
+]

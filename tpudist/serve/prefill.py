@@ -1,0 +1,139 @@
+"""Chunked, bucketed prompt prefill for the serving engine.
+
+A new request's prompt runs through the model's bulk decode path (causal
+within the chunk — the same pass :func:`tpudist.generate.generate` uses)
+on a FRESH batch-1 cache, in chunks of at most ``chunk`` tokens with the
+final partial chunk padded to a power-of-two bucket
+(:func:`tpudist.generate.bucket_length`). The compile set is therefore
+bounded: one program per (bucket length) — a handful for any traffic mix —
+instead of one per prompt length, the pjit-paper shape discipline applied
+to serving. The prefilled cache is then scattered into a free pool slot
+(:func:`tpudist.serve.slots.write_slot`) and the request joins the shared
+decode batch.
+
+Bit-exactness note: a prompt that fits ONE chunk runs the identical
+bucket-padded program shape as ``generate()``'s prefill, which is what
+makes greedy continuous-batching output bit-identical to the static path
+(pinned in tests/test_serve.py). Longer prompts split across chunks are
+the same function in exact arithmetic, but chunk boundaries change XLA's
+fusion shapes, so cross-chunk prompts are only almost-everywhere
+token-identical.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudist.generate import bucket_length
+
+
+@jax.jit
+def _index_logits(logits, i):
+    """``logits [1, L, V]`` at traced row ``i`` → ``[V]`` (one compile for
+    every in-chunk position of the last real token)."""
+    return jax.lax.dynamic_index_in_dim(logits[0], i, axis=0, keepdims=False)
+
+
+class Prefiller:
+    """Callable turning a prompt into ``(row_cache, last_logits)``: a
+    batch-1 cache holding the prompt's K/V and the logits after the
+    prompt's LAST real token (the first sampled position — the request's
+    time-to-first-token is the latency of this call plus one sample).
+
+    ``model`` and ``params`` bind at construction: the chunk program
+    closes over the weights (per-instance jit) instead of tracing them as
+    arguments — traced params make XLA re-canonicalize the weight layouts
+    per CALL, a per-admission tax the static path never sees because one
+    ``generate()`` call amortizes it over the whole scan — and the fresh
+    cache's eval_shape (a full model-init retrace, ~100 ms at 124M) runs
+    once here, not per request."""
+
+    def __init__(self, model, params, *, chunk: int = 512, minimum: int = 8):
+        self.model = model
+        self.chunk = min(int(chunk), model.max_seq_len)
+        self.minimum = minimum
+        if self.chunk < 1:
+            raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+        self._cache_shapes = jax.eval_shape(
+            lambda: model.init(
+                jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
+                train=False, decode=True,
+            )
+        )["cache"]
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def chunk_body(cache, toks):
+            # non-final chunks only feed the KV cache — return_hidden
+            # skips the LM head entirely (at GPT-2's vocab a 512-token
+            # chunk's discarded [1, 512, V] fp32 logits are ~100 MB of
+            # HBM traffic plus the head matmul, per admission)
+            _, updates = model.apply(
+                {"params": params, "cache": cache}, toks,
+                train=False, decode=True, mutable=["cache"],
+                return_hidden=True,
+            )
+            return updates["cache"]
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def chunk_final(cache, toks):
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, toks,
+                train=False, decode=True, mutable=["cache"],
+            )
+            return updates["cache"], logits
+
+        self._chunk_body = chunk_body
+        self._chunk_final = chunk_final
+
+    def chunk_plan(self, p: int) -> list[tuple[int, int]]:
+        """The ``(real, padded)`` chunk lengths a ``p``-token prompt runs
+        as (full chunks, then the remainder's bucket) — the ONE place the
+        split is computed (``__call__`` iterates it), exposed so tests can
+        pin the compile-count contract. The bucket is capped by BOTH the
+        chunk size and the cache space left (``max_seq_len - offset``):
+        the scalar cursor advances by PADDED lengths, so an uncapped final
+        bucket on a near-full prompt would write past the cache end —
+        dynamic_update_slice clamps the start, misaligning the prefix K/V
+        silently (the cap is always >= the real length because the prompt
+        itself fits the cache)."""
+        plan, off = [], 0
+        while off < p:
+            n = min(self.chunk, p - off)
+            plan.append((n, bucket_length(
+                n, cap=min(self.chunk, self.model.max_seq_len - off),
+                minimum=self.minimum,
+            )))
+            off += n
+        return plan
+
+    def __call__(self, prompt):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        if not 0 < p <= self.model.max_seq_len:
+            raise ValueError(
+                f"prompt length {p} outside (0, {self.model.max_seq_len}]"
+            )
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
+        )
+        plan = self.chunk_plan(p)
+        off, logits, last = 0, None, 0
+        for i, (n, padded) in enumerate(plan):
+            toks = np.zeros((1, padded), np.int32)
+            toks[0, :n] = prompt[off : off + n]
+            toks = jnp.asarray(toks)
+            if i + 1 < len(plan):
+                cache = self._chunk_body(cache, toks)
+            else:
+                cache, logits = self._chunk_final(cache, toks)
+            off += n
+            last = n - 1
+        # NOTE on the cursor: after a padded final chunk the cache's scalar
+        # cursors sit past p. The pool scatter copies only the 4-D buffers
+        # (slots.write_slot) and the engine owns the slot's true length, so
+        # the overshoot never escapes this function.
+        return cache, _index_logits(logits, jnp.asarray(last, jnp.int32))

@@ -1,0 +1,117 @@
+"""Slot-pooled KV cache: one pre-allocated ``[max_slots, ...]`` decode
+cache whose batch rows are SLOTS that requests occupy and vacate between
+decode steps.
+
+The shape discipline that makes continuous batching XLA-native: the pool
+is allocated once (``zero_cache`` at ``batch_size=max_slots``), every
+decode step runs over the FULL slot batch with per-slot positions and an
+active mask (``tpudist.ops.decode.cached_kv(positions=...)``), and
+admission/retirement are pure bookkeeping plus one compiled scatter
+(:func:`write_slot`) — zero recompiles as requests join and leave. A
+request's lifecycle against the pool:
+
+1. **acquire** — a free slot index is taken (FIFO recycle order, so slot
+   assignment is deterministic for tests);
+2. **insert** — the prefilled batch-1 cache (``tpudist.serve.prefill``) is
+   scattered over the slot's rows; the full buffer is copied, so whatever
+   a previous occupant left above the new prompt's length is overwritten
+   or sits above the cursor where the per-slot mask never admits it;
+3. **advance** — each decode step writes the slot's token at its own
+   cursor and the engine bumps ``positions[slot]``;
+4. **release** — the slot returns to the free list; nothing is zeroed
+   (the next insert overwrites, and masked slots are never read).
+"""
+
+from __future__ import annotations
+
+import collections
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def write_slot(pool, row_cache, slot):
+    """Scatter a prefilled batch-1 cache into row ``slot`` of the pool
+    (donated — the pool updates in place, no second copy of the full
+    ``[max_slots, H, max_len, dh]`` buffers). Only the 4-D K/V buffers
+    transfer; the scalar cursors (``cache_index``, GPT-2's ``position``)
+    keep the pool's values — per-slot lengths live with the engine, not
+    in the cache tree."""
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def put(dst, src):
+        if getattr(src, "ndim", 0) == 4 and dst.ndim == 4:
+            return jax.lax.dynamic_update_slice(
+                dst, src.astype(dst.dtype), (slot, 0, 0, 0)
+            )
+        return dst
+
+    return jax.tree_util.tree_map(put, pool, row_cache)
+
+
+class SlotPool:
+    """The pool cache plus host-side slot bookkeeping. ``cache`` is the
+    live device pytree the engine's compiled decode step donates through;
+    ``positions``/``active`` are the per-slot masks it feeds in."""
+
+    def __init__(self, model, max_slots: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if not hasattr(model, "init_cache"):
+            raise ValueError(
+                f"{type(model).__name__} has no init_cache hook (the decode "
+                "contract tpudist.serve requires); GPT-2 and Llama carry it"
+            )
+        self.max_slots = max_slots
+        self.max_seq_len = model.max_seq_len
+        self.cache = model.init_cache(max_slots)
+        self.positions = np.zeros(max_slots, np.int32)
+        self.active = np.zeros(max_slots, bool)
+        # FIFO recycle order: deterministic slot assignment, and a retired
+        # slot goes to the BACK of the line (its stale K/V ages out of HBM
+        # cache lines naturally instead of being rewritten immediately)
+        self._free: collections.deque[int] = collections.deque(
+            range(max_slots)
+        )
+
+    @property
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        return self.n_active / self.max_slots
+
+    def insert(self, row_cache, true_len: int) -> int:
+        """Scatter a prefilled batch-1 cache into a free slot; returns the
+        slot index. Raises when the pool is full — the engine's admission
+        control checks ``n_free`` first, so hitting this is a bug."""
+        if not self._free:
+            raise RuntimeError("slot pool exhausted (admission bug)")
+        if not 0 < true_len <= self.max_seq_len:
+            raise ValueError(
+                f"prefix length {true_len} outside (0, {self.max_seq_len}]"
+            )
+        slot = self._free.popleft()
+        self.cache = write_slot(self.cache, row_cache, slot)
+        self.positions[slot] = true_len
+        self.active[slot] = True
+        return slot
+
+    def advance(self, slot: int) -> None:
+        """One decode step wrote this slot's token at its cursor; bump it."""
+        self.positions[slot] += 1
+
+    def release(self, slot: int) -> None:
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} released twice")
+        self.active[slot] = False
+        self.positions[slot] = 0
+        self._free.append(slot)

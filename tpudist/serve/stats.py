@@ -1,0 +1,161 @@
+"""Latency-SLO accounting for the serving engine: TTFT, TPOT, queue depth,
+slot utilization, decode throughput — streamed as ``serve`` JSONL rows
+through the existing :class:`tpudist.telemetry.TelemetrySink` (schema in
+docs/OBSERVABILITY.md), with a terminal ``serve_summary`` row.
+
+The two latency SLOs a serving deployment is actually held to:
+
+- **TTFT** (time to first token): submit → the request's first streamed
+  token. Under continuous batching this is queue wait + one prefill + one
+  sample; under static batching it includes waiting for the whole batch
+  to assemble — the number the bench leg's comparison shows collapsing.
+- **TPOT** (time per output token): the mean inter-token gap AFTER the
+  first token, ``(t_done - t_first) / (n_tokens - 1)`` — the streaming
+  cadence a reader experiences.
+
+Percentiles are computed over a sliding window of the most recent
+``SLO_WINDOW`` samples (p50/p95 via numpy) — bounded memory and a bounded
+per-row percentile pass on a server that lives for millions of requests;
+interval quantities (tokens/s, utilization) reset at each ``serve`` row
+so the stream shows the live state, not a lifetime average.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+import numpy as np
+
+# sliding-window size for the TTFT/TPOT percentile samples: recent-enough
+# to be an SLO signal, bounded so a long-lived server neither grows the
+# sample lists nor pays an ever-larger percentile sort per telemetry row
+SLO_WINDOW = 4096
+
+
+def _pct(xs, q) -> float | None:
+    return None if not xs else round(float(np.percentile(list(xs), q)), 6)
+
+
+def fmt_s(x, scale: float = 1.0, digits: int = 3) -> str:
+    """Human-display helper for snapshot fields that are ``None`` until
+    the first sample lands (percentiles before any completion, utilization
+    before any decode step): ``n/a`` instead of a format TypeError."""
+    return "n/a" if x is None else f"{x * scale:.{digits}f}"
+
+
+class ServeStats:
+    """Host-side SLO bookkeeping, driven by the engine: ``on_submit`` /
+    ``on_first_token`` / ``on_done`` per request, ``on_decode_step`` per
+    compiled step, ``on_tick`` once per scheduler tick (writes the cadence
+    row). ``sink=None`` keeps full accounting with no stream (the bench
+    and the notebook path read :meth:`snapshot` directly)."""
+
+    def __init__(self, *, slots: int, sink=None, every: int = 50,
+                 clock=time.perf_counter):
+        self.slots = slots
+        self.sink = sink
+        self.every = max(int(every), 0)
+        self._clock = clock
+        self.t_start = clock()
+        self.submitted = 0
+        self.completed = 0
+        self.tokens = 0
+        self.ttft: collections.deque[float] = collections.deque(
+            maxlen=SLO_WINDOW
+        )
+        self.tpot: collections.deque[float] = collections.deque(
+            maxlen=SLO_WINDOW
+        )
+        self._arrival: dict[int, float] = {}
+        self._first: dict[int, float] = {}
+        # interval accumulators (reset at each serve row)
+        self._win_t0 = self.t_start
+        self._win_tokens = 0
+        self._win_active = 0
+        self._win_steps = 0
+        # lifetime slot-occupancy accumulators (never reset — snapshot())
+        self._life_active = 0
+        self._life_steps = 0
+
+    # -- per-request lifecycle --------------------------------------------
+
+    def on_submit(self, request_id: int) -> None:
+        self.submitted += 1
+        self._arrival[request_id] = self._clock()
+
+    def on_first_token(self, request_id: int) -> None:
+        t = self._clock()
+        self._first[request_id] = t
+        self.ttft.append(t - self._arrival.pop(request_id, t))
+        # the first token comes from prefill, not a decode step — count it
+        # here so throughput covers every emitted token
+        self.tokens += 1
+        self._win_tokens += 1
+
+    def on_done(self, request_id: int, n_tokens: int) -> None:
+        self.completed += 1
+        first = self._first.pop(request_id, None)
+        if first is not None and n_tokens > 1:
+            self.tpot.append((self._clock() - first) / (n_tokens - 1))
+
+    # -- per-step drive ----------------------------------------------------
+
+    def on_decode_step(self, active: int, emitted: int) -> None:
+        self.tokens += emitted
+        self._win_tokens += emitted
+        self._win_active += active
+        self._win_steps += 1
+        self._life_active += active
+        self._life_steps += 1
+
+    def on_tick(self, step: int, *, queue_depth: int, active: int) -> None:
+        if self.sink is None or not self.every or step % self.every:
+            return
+        self.sink.write("serve", step, **self._window_row(queue_depth, active))
+        self._win_t0 = self._clock()
+        self._win_tokens = self._win_active = self._win_steps = 0
+
+    # -- readouts ----------------------------------------------------------
+
+    def _window_row(self, queue_depth: int, active: int) -> dict:
+        dt = max(self._clock() - self._win_t0, 1e-9)
+        return {
+            "queue_depth": queue_depth,
+            "active": active,
+            "slots": self.slots,
+            "slot_utilization": (
+                round(self._win_active / (self.slots * self._win_steps), 4)
+                if self._win_steps else 0.0
+            ),
+            "tokens_per_sec": round(self._win_tokens / dt, 2),
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "ttft_p50": _pct(self.ttft, 50),
+            "ttft_p95": _pct(self.ttft, 95),
+            "tpot_p50": _pct(self.tpot, 50),
+            "tpot_p95": _pct(self.tpot, 95),
+        }
+
+    def snapshot(self) -> dict:
+        """Lifetime totals (the bench record's fields)."""
+        wall = max(self._clock() - self.t_start, 1e-9)
+        return {
+            "wall_s": round(wall, 6),
+            "tokens": self.tokens,
+            "tokens_per_sec": round(self.tokens / wall, 2),
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "slot_utilization": (
+                round(self._life_active / (self.slots * self._life_steps), 4)
+                if self._life_steps else None
+            ),
+            "ttft_p50": _pct(self.ttft, 50),
+            "ttft_p95": _pct(self.ttft, 95),
+            "tpot_p50": _pct(self.tpot, 50),
+            "tpot_p95": _pct(self.tpot, 95),
+        }
+
+    def write_summary(self, step: int) -> None:
+        if self.sink is not None:
+            self.sink.write("serve_summary", step, **self.snapshot())

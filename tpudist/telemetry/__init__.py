@@ -168,8 +168,9 @@ class TelemetrySink:
     ``step_breakdown``, ``mfu``, ``throughput``, ``memory``, ``anomaly``,
     ``heartbeat``, ``train_time``, ``run_meta``, ``comm`` (explicit
     gradient reduction's one-time wire accounting), ``warning`` (tagged
-    one-shot diagnoses, e.g. ``h2d_link_bound``). Schema glossary in
-    docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
+    one-shot diagnoses, e.g. ``h2d_link_bound``). The serving engine
+    (``tpudist.serve``) writes ``serve``/``serve_summary`` SLO rows
+    through the same sink. Schema glossary in docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
     APPEND mode — both halves of the flight-recorder contract: the anomaly
     row must survive the crash it describes, including a checkpoint-resume
     of the same job_id truncating the evidence before anyone read it.
