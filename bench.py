@@ -73,6 +73,13 @@ Legs
    contract: the 124M step compiled bare vs with in-step health metrics +
    the non-finite update guard (interleaved A/B); must stay under 2%
    step-time overhead (docs/OBSERVABILITY.md).
+13b. ``gpt2_124m_fused_tail_tokens_per_sec_per_chip`` — the step-fusion
+   layer's perf contract (docs/PERF.md §4c): the 124M step unfused vs
+   ``fused="all"`` (Pallas fused residual-add+LN + one-pass fused-AdamW
+   with the bf16 compute-copy forward), interleaved A/B. value = the
+   fused rate; the record's explicit ``vs_unfused`` field is the
+   tail-closure factor, and the per-kernel achieved HBM GB/s
+   (examples/kernel_probe.py) ride along.
 14. ``gpt2_124m_quantized_ar_tokens_per_sec_per_chip`` /
    ``gpt2_124m_comm_bytes_per_step`` — the communication-efficiency legs
    (docs/PERF.md §11): the same 124M step trained through the explicit
@@ -1417,6 +1424,115 @@ def bench_telemetry_overhead() -> None:
     )
 
 
+def bench_fusion() -> None:
+    """The step-fusion layer's perf contract (docs/PERF.md §4c): the SAME
+    GPT-2 124M train step (bf16, vmem attention, chunk-512 CE, 8x4 accum —
+    the leg-4 config) driven unfused (optax adam + flax LNs) vs fused
+    (``make_train_step(fused="all")``: Pallas fused residual-add+LN in
+    every block + the one-pass fused-AdamW kernel with the bf16
+    compute-copy forward). Interleaved A/B windows so attach drift lands
+    on both sides. value = the FUSED rate; ``vs_unfused`` = fused/unfused
+    (the tail-closure factor §4b's accounting predicts — the explicit A/B
+    field this leg exists for); vs_baseline = fused rate / the 50k
+    tok/s/chip target. The record also carries the per-kernel achieved
+    HBM GB/s (examples/kernel_probe.py's measurement inlined) so the
+    bandwidth claim is auditable next to the throughput claim."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+    from tpudist.optim import fused_adamw
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    seq_len, micro_per_chip, grad_accum = 1024, 8, 4
+    seqs_per_step = micro_per_chip * grad_accum * n_chips
+    tokens_per_step = seqs_per_step * seq_len
+
+    model = GPT2(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
+
+    def build(fused: bool):
+        tx = (
+            fused_adamw(1e-3, compute_dtype=jnp.bfloat16)
+            if fused else optax.adam(1e-3)
+        )
+        state = create_train_state(
+            model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", grad_accum=grad_accum,
+            forward_loss=chunked_lm_forward(model, chunk=512),
+            fused="all" if fused else None,
+        )
+        return state, step
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    n_rounds, window = 4, 8
+    batches = [
+        rng.integers(0, 50257, (seqs_per_step, seq_len)).astype(np.int32)
+        for _ in range(window)
+    ]
+
+    sides = {name: build(name == "fused") for name in ("unfused", "fused")}
+    times = {"unfused": 0.0, "fused": 0.0}
+    for name, (state, step) in sides.items():  # compile + warmup
+        for b in batches[:3]:
+            state, metrics = step(state, {"tokens": b})
+        jax.block_until_ready(metrics["loss"])
+        sides[name] = (state, step)
+    for _ in range(n_rounds):
+        for name in ("unfused", "fused"):
+            state, step = sides[name]
+            t0 = time.perf_counter()
+            for b in batches:
+                state, metrics = step(state, {"tokens": b})
+            float(metrics["loss"])
+            times[name] += time.perf_counter() - t0
+            sides[name] = (state, step)
+
+    steps_per_side = n_rounds * window
+    rate = {k: tokens_per_step * steps_per_side / v / n_chips
+            for k, v in times.items()}
+
+    # per-kernel achieved HBM GB/s at the step's shapes — the bandwidth
+    # side of the §4c accounting, recorded next to the throughput A/B
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "examples"))
+    import kernel_probe
+
+    ln_fwd, ln_full = kernel_probe.probe_ln(
+        micro_per_chip * seq_len, 768, jnp.bfloat16,
+        bw=kernel_probe.V5E_HBM_BW, reps=3,
+    )
+    upd = kernel_probe.probe_fused_update(
+        8_000_000, bw=kernel_probe.V5E_HBM_BW, reps=3,
+    )
+
+    _record_line(
+        {
+            "metric": "gpt2_124m_fused_tail_tokens_per_sec_per_chip",
+            "value": round(rate["fused"], 2),
+            "unit": "tokens/sec/chip with the step-fusion layer on "
+            "(fused Pallas residual-add+LN in every block + one-pass "
+            "fused-AdamW with the bf16 compute-copy forward) vs the "
+            f"identical unfused step: {round(rate['fused'], 1)} fused vs "
+            f"{round(rate['unfused'], 1)} unfused tok/s/chip (interleaved "
+            "A/B); vs_unfused = fused/unfused (the §4b tail-closure "
+            "factor); vs_baseline = fused rate / the 50k target; "
+            "ln/update GB/s = achieved kernel HBM bandwidth vs the 819 "
+            "GB/s roofline (docs/PERF.md §4c)",
+            "vs_unfused": round(rate["fused"] / rate["unfused"], 4),
+            "unfused_rate_tok_s_chip": round(rate["unfused"], 2),
+            "ln_fwd_gbps": round(ln_fwd / 1e9, 1),
+            "ln_fwd_bwd_gbps": round(ln_full / 1e9, 1),
+            "fused_adamw_gbps": round(upd / 1e9, 1),
+            "vs_baseline": round(rate["fused"] / TARGET_TOK_PER_SEC_PER_CHIP, 4),
+        }
+    )
+
+
 def bench_run_health() -> None:
     """The run-health layer's perf contract (docs/OBSERVABILITY.md §7):
     the SAME GPT-2 124M step driven bare, and with the replica-divergence
@@ -1782,6 +1898,9 @@ _LEG_GROUPS = {
     "memory": (bench_memory_discipline, 1500),
     # two compiles of the 124M step + 2x4x8 measured steps
     "telemetry": (bench_telemetry_overhead, 1800),
+    # two compiles of the 124M step (unfused + fused) + 2x4x8 measured
+    # steps + three differential kernel-bandwidth probes
+    "fusion": (bench_fusion, 2400),
     # one compile of the quantized-AR step + 30 measured steps; the byte
     # record is pure accounting
     "comm": (bench_comm_efficiency, 1800),

@@ -26,9 +26,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,8 +36,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # single source of truth for the analytic counters, the GEMM-shape table,
 # and the peak default (tpudist.telemetry.flops): this file keeps only the
-# CLI and the on-chip timing harness — the math it times lives with the
-# MFU accounting that fit()'s telemetry and bench.py's legs share
+# CLI — the math it times lives with the MFU accounting that fit()'s
+# telemetry and bench.py's legs share, and the differential-timing
+# skeleton (adaptive iters, (t(4n)−t(n))/3n, anti-hoisting operands,
+# plausibility retries) lives in tpudist.telemetry.microbench so this
+# probe and examples/kernel_probe.py measure the same way
+from tpudist.telemetry import microbench  # noqa: E402
 from tpudist.telemetry.flops import DEFAULT_PEAK_FLOPS, gpt2_step_shapes  # noqa: E402
 
 
@@ -47,52 +49,24 @@ def time_gemm(m: int, k: int, n: int, *, reps: int = 5,
               peak: float = DEFAULT_PEAK_FLOPS) -> float:
     """Median achieved FLOP/s for a bf16 [m,k]x[k,n] matmul.
 
-    Differential timing — ``(t(4n) − t(n)) / 3n`` — cancels per-call fixed
-    costs (dispatch, the remote tunnel's ~100 ms ±100 ms RTT), and the
-    iteration count is ADAPTIVE so the differential itself is ~1.5 s of
+    Differential timing (tpudist.telemetry.microbench) cancels per-call
+    fixed costs (dispatch, the remote tunnel's ~100 ms ±100 ms RTT);
+    iteration counts are ADAPTIVE so the differential spans ~1.5 s of
     device time, far above the tunnel's jitter (a fixed small count read
     impossible >100%-peak values through the noise)."""
     rng = np.random.Generator(np.random.PCG64(0))
     x = jnp.asarray(rng.standard_normal((m, k)), jnp.bfloat16)
     w = jnp.asarray(rng.standard_normal((k, n)), jnp.bfloat16)
 
-    @jax.jit
-    def run(x, w, scales):
-        def body(acc, s):
-            # per-iter scaled operand: the matmul cannot be hoisted out of
-            # the loop, and the accumulation keeps every iteration live
-            return acc + (x * s) @ w, None
-
-        acc0 = jnp.zeros((m, n), jnp.float32)
-        acc, _ = jax.lax.scan(body, acc0, scales)
-        return acc[0, 0]
-
-    def timed(n_iters: int) -> float:
-        scales = jnp.asarray(1.0 + np.arange(n_iters) * 1e-6, jnp.bfloat16)
-        run(x, w, scales).block_until_ready()  # compile
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(run(x, w, scales))  # value fetch = real sync on remote
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times))
-
-    # iteration budget from an optimistic per-iter estimate (50% of peak,
-    # bandwidth floor included): 3n iters of differential ≈ 1.5 s device
-    est = max(
-        2.0 * m * k * n / (0.5 * peak),
-        2.0 * (m * k + k * n + m * n) / 819e9,
+    timed = microbench.anti_hoist_scan(lambda xs: xs @ w, x, reps=reps)
+    flops = 2.0 * m * k * n
+    # optimistic per-iter estimate (50% of peak, bandwidth floor included)
+    est = max(flops / (0.5 * peak),
+              2.0 * (m * k + k * n + m * n) / 819e9)
+    dt = microbench.measure_iter_seconds(
+        timed, est, floor_s=flops / (1.05 * peak)
     )
-    iters = int(np.clip(0.5 / est, 64, 8192))
-    for attempt in range(3):
-        dt = (timed(4 * iters) - timed(iters)) / (3 * iters)
-        fl = 2.0 * m * k * n / dt if dt > 0 else float("inf")
-        # a non-positive or >105%-of-peak differential is tunnel jitter,
-        # not physics — retry with a bigger budget rather than print it
-        if 0 < fl <= 1.05 * peak:
-            return fl
-        iters = min(iters * 2, 16384)
-    return float("nan")  # persistently noisy; rendered as nan, never fake
+    return flops / dt if dt > 0 else float("nan")
 
 
 def main() -> None:

@@ -71,6 +71,14 @@ def parse_args(argv=None):
                    "(tpudist.optim.shard_state): Adam mirrors live "
                    "~1/world_size per chip; with --remat_policy this is "
                    "the ~1B-on-16GB recipe (docs/PERF.md §10)")
+    p.add_argument("--fused", default="none",
+                   choices=["none", "auto", "ln", "optimizer", "all"],
+                   help="step-fusion layer (docs/PERF.md §4c): 'ln' = the "
+                   "Pallas fused residual-add+LayerNorm kernel in every "
+                   "block, 'optimizer' = the one-pass fused-AdamW kernel "
+                   "(+ bf16 compute-copy forward under --bf16; requires "
+                   "--optimizer adam), 'all' both, 'auto' whatever the "
+                   "model/optimizer support")
     p.add_argument("--chunked_ce", default=0, type=int,
                    help="sequence-chunked weight-tied CE (chunk size); the "
                    "[B,S,V] logits never materialize — raises the max batch/"
@@ -338,12 +346,21 @@ def main(argv=None):
 
     steps_per_epoch = len(loader)
     total = args.total_steps or args.epochs * steps_per_epoch
+    # --fused optimizer/all/auto builds the one-pass fused-AdamW kernel
+    # (auto only when the optimizer is adam — the kernel implements the
+    # adam/adamw update); under --bf16 it also keeps the bf16 compute
+    # copy the fused step's forward reads
+    fuse_opt = args.fused in ("optimizer", "all") or (
+        args.fused == "auto" and args.optimizer == "adam"
+    )
     tx = make_optimizer(
         run_schedule(args.lr, total_steps=total,
                      warmup_steps=args.warmup_steps),
         optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
         skip_nonfinite_updates=args.amp,
+        fused=fuse_opt,
+        compute_dtype=dtype if dtype != jnp.float32 else None,
     )
 
     def build_forward_loss(mdl):
@@ -412,6 +429,7 @@ def main(argv=None):
             loss_fn=lm_loss, input_key="tokens", label_key="tokens",
             grad_accum=args.grad_accum, remat=remat,
             shard_opt_state=args.shard_opt_state,
+            fused=None if args.fused == "none" else args.fused,
             batch_spec=batch_spec, forward_loss=fwd_loss,
             profile=not args.no_profiler, log_dir=args.log_dir,
             telemetry=args.telemetry,

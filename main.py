@@ -109,6 +109,16 @@ def parse_args(argv=None):
                         help="smoothed-CE epsilon in [0,1) (ImageNet recipe: "
                         "0.1); 0 = the reference's plain CE (main.py:79)")
     parser.add_argument("--grad_accum", default=1, type=int)
+    parser.add_argument("--fused", default="none",
+                        choices=["none", "auto", "ln", "optimizer", "all"],
+                        help="step-fusion layer (docs/PERF.md §4c): 'ln' = "
+                        "Pallas fused residual-add+LayerNorm in the "
+                        "transformer blocks (vit_b16), 'optimizer' = the "
+                        "one-pass fused-AdamW kernel (requires --optimizer "
+                        "adam; under --bf16 the forward reads its bf16 "
+                        "compute copy), "
+                        "'all' both, 'auto' whatever model/optimizer "
+                        "support")
     parser.add_argument("--reduce", default="none",
                         choices=("none", "bucketed", "quantized", "auto"),
                         help="gradient-reduction path (tpudist.parallel.dp)"
@@ -464,10 +474,17 @@ def main(argv=None):
         )
     else:
         lr = args.lr
+    fuse_opt = args.fused in ("optimizer", "all") or (
+        args.fused == "auto" and args.optimizer == "adam"
+    )
     tx = make_optimizer(
         lr, optimizer=args.optimizer,
         weight_decay=args.weight_decay, clip_norm=args.clip_norm,
         skip_nonfinite_updates=args.amp,
+        fused=fuse_opt,
+        # the compute copy only pays when the model computes in a narrower
+        # dtype than the fp32 masters
+        compute_dtype=dtype if dtype != jnp.float32 else None,
     )
     if args.label_smoothing:
         from tpudist.train import smoothed_cross_entropy
@@ -494,6 +511,7 @@ def main(argv=None):
         global_rank=ctx.process_index,
         grad_accum=args.grad_accum,
         reduce=args.reduce,
+        fused=None if args.fused == "none" else args.fused,
         input_transform=input_transform,
         profile=not args.no_profiler,
         log_dir=args.log_dir,

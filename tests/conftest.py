@@ -110,6 +110,41 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(_pytest.mark.smoke)
 
 
+def assert_kernel_parity(got, want, *, rtol=None, atol=None):
+    """The ONE interpret-mode parity bar for the Pallas kernels (flash /
+    vmem attention, fused LN, fused AdamW): full-precision references get
+    the flash/vmem suites' historical ``rtol=atol=2e-5``; half-precision
+    references (bf16/fp16) get 2% of the reference's max magnitude —
+    ≈2 ulp at the output scale, because a kernel computing its interior in
+    fp32 legitimately differs from a reference that rounds intermediates
+    to bf16 by up to an output-magnitude ulp. Kernel tests share this
+    helper (the ``kernel_parity`` fixture) so the bar cannot drift
+    per-file."""
+    import numpy as np
+
+    ref_dtype = np.asarray(want).dtype
+    g = np.asarray(got, np.float32)
+    w = np.asarray(want, np.float32)
+    if ref_dtype.itemsize <= 2:
+        scale = float(max(np.max(np.abs(w)), 1e-6))
+        np.testing.assert_allclose(
+            g, w, rtol=rtol or 0.0,
+            atol=atol if atol is not None else 2e-2 * scale,
+        )
+    else:
+        np.testing.assert_allclose(
+            g, w, rtol=2e-5 if rtol is None else rtol,
+            atol=2e-5 if atol is None else atol,
+        )
+
+
+@pytest.fixture
+def kernel_parity():
+    """Fixture handle on :func:`assert_kernel_parity` — request it in any
+    Pallas-kernel test instead of hand-picking tolerances."""
+    return assert_kernel_parity
+
+
 def tiny_resnet():
     """2-stage/1-block/8-filter ResNet: same BN + residual + strided-stage
     topology as resnet18 at a fraction of the compile bill. The shared

@@ -16,20 +16,18 @@ def _qkv(b=2, s=256, h=4, d=64, dtype=jnp.float32, seed=0):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_forward_matches_xla(causal):
+def test_forward_matches_xla(causal, kernel_parity):
     q, k, v = _qkv()
     out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
     ref = dot_product_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    kernel_parity(out, ref)
 
 
-def test_forward_bf16():
+def test_forward_bf16(kernel_parity):
     q, k, v = _qkv(dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True)
     ref = dot_product_attention(q, k, v, causal=True)
-    np.testing.assert_allclose(
-        out.astype(np.float32), ref.astype(np.float32), atol=2e-2, rtol=2e-2
-    )
+    kernel_parity(out, ref)
 
 
 def test_multiple_k_blocks_small_blocks():

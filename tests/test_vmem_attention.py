@@ -22,13 +22,11 @@ def _qkv(b, s, h, d, seed=0, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_matches_oracle_aligned(causal):
+def test_matches_oracle_aligned(causal, kernel_parity):
     q, k, v = _qkv(2, 256, 2, 64, seed=1)
     out = vmem_attention(q, k, v, causal=causal)
     ref = dot_product_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
-    )
+    kernel_parity(out, ref)
 
 
 def test_matches_oracle_ragged_vit_shape():

@@ -27,7 +27,7 @@ from tpudist.distributed import DistributedContext, init_from_env, reduce_loss
 from tpudist.data.sampler import DistributedSampler
 from tpudist.store import TCPStore
 from tpudist.amp import Policy, policy_for, skip_nonfinite
-from tpudist.optim import make_optimizer, run_schedule, warmup_cosine
+from tpudist.optim import fused_adamw, make_optimizer, run_schedule, warmup_cosine
 from tpudist.telemetry import TelemetryConfig
 from tpudist.resilience import Preempted
 
@@ -46,6 +46,7 @@ __all__ = [
     "Policy",
     "policy_for",
     "skip_nonfinite",
+    "fused_adamw",
     "make_optimizer",
     "run_schedule",
     "warmup_cosine",

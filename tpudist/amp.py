@@ -14,7 +14,7 @@ guards for the rare bf16 overflow spike.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -89,7 +89,20 @@ def nonfinite_count(tree) -> jax.Array:
     return jnp.asarray(sum(counts), jnp.int32)
 
 
-def skip_nonfinite(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+class SkipNonfinite(NamedTuple):
+    """:func:`skip_nonfinite`'s return type: the optax ``(init, update)``
+    surface plus ``inner`` — the wrapped transformation, kept visible so
+    capability probes (``tpudist.optim``'s fused-optimizer detection) can
+    walk through the wrapper the same way they walk through
+    ``ShardedStateOptimizer.inner``. Every existing consumer duck-types
+    ``init``/``update`` and is unaffected."""
+
+    init: Callable
+    update: Callable
+    inner: Any
+
+
+def skip_nonfinite(tx: optax.GradientTransformation) -> SkipNonfinite:
     """Wrap an optimizer so steps with non-finite gradients become no-ops.
 
     A bf16 overflow spike (or a data glitch) then skips one update instead
@@ -120,7 +133,7 @@ def skip_nonfinite(tx: optax.GradientTransformation) -> optax.GradientTransforma
         )
         return updates, (inner, skipped + jnp.where(ok, 0, 1))
 
-    return optax.GradientTransformation(init, update)
+    return SkipNonfinite(init, update, tx)
 
 
 def skipped_steps(opt_state) -> int:

@@ -42,6 +42,11 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0
     mesh: Any = None
+    # fused_ln=True: both post-LNs run the Pallas fused residual-add+LN
+    # kernel (tpudist.ops.layernorm) — the post-norm composition is the
+    # ideal fusion target (the sum never needs a separate HBM round trip;
+    # only the normed value is written). Same param names as nn.LayerNorm.
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True, attention_mask=None):
@@ -51,6 +56,16 @@ class EncoderBlock(nn.Module):
             nn.Dropout(self.dropout, deterministic=not train)(y)
             if self.dropout else y
         )
+        if self.fused_ln:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            post_ln = lambda name, res, y: FusedLayerNorm(
+                epsilon=1e-12, dtype=self.dtype, mesh=self.mesh, name=name
+            )(y, residual=res, return_residual=False)
+        else:
+            post_ln = lambda name, res, y: nn.LayerNorm(
+                epsilon=1e-12, dtype=self.dtype, name=name
+            )(res + y)
         dense_init = nn.initializers.lecun_normal()
         # column-parallel qkv / row-parallel out — same TP scheme as the
         # decoder Block (tpudist/models/gpt2.py), no causal mask
@@ -108,9 +123,7 @@ class EncoderBlock(nn.Module):
             d, axis=(-2, -1), dtype=self.dtype, name="out",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
         )(attn)
-        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln_attn")(
-            x + drop(y)
-        )
+        x = post_ln("ln_attn", x, drop(y))
         y = nn.Dense(
             4 * d, dtype=self.dtype, name="mlp_fc",
             kernel_init=_partitioned(dense_init, None, TENSOR_AXIS),
@@ -123,9 +136,7 @@ class EncoderBlock(nn.Module):
             d, dtype=self.dtype, name="mlp_proj",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None),
         )(y)
-        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln_mlp")(
-            x + drop(y)
-        )
+        return post_ln("ln_mlp", x, drop(y))
 
 
 class MlmHead(nn.Module):
@@ -162,12 +173,14 @@ class _CarryEncoderBlock(nn.Module):
     attn_impl: str = "xla"
     mesh: Any = None
     dropout: float = 0.0
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, attention_mask):
         x = EncoderBlock(
             self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
-            mesh=self.mesh, dropout=self.dropout, name="block",
+            mesh=self.mesh, dropout=self.dropout, fused_ln=self.fused_ln,
+            name="block",
         )(x, train=self.train, attention_mask=attention_mask)
         return x, None
 
@@ -188,6 +201,10 @@ class Bert(nn.Module):
     # (one traced layer at any depth; params stack [depth, ...])
     scan_layers: bool = False
     remat_layers: bool = False
+    # fused_ln=True: the embedding LN and every block's post-LNs run the
+    # Pallas fused residual-add+LN kernel (tpudist.ops.layernorm). Same
+    # param tree; usually set via make_train_step(fused="ln"|"all").
+    fused_ln: bool = False
 
     @property
     def flops_counter(self) -> str:
@@ -222,9 +239,17 @@ class Bert(nn.Module):
                 jnp.zeros_like(tokens) if token_types is None else token_types
             )
             x = x + wty[types]
-        x = nn.LayerNorm(
-            epsilon=1e-12, dtype=self.dtype, name="ln_embed"
-        )(x.astype(self.dtype))
+        if self.fused_ln:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            x = FusedLayerNorm(
+                epsilon=1e-12, dtype=self.dtype, mesh=self.mesh,
+                name="ln_embed",
+            )(x.astype(self.dtype))
+        else:
+            x = nn.LayerNorm(
+                epsilon=1e-12, dtype=self.dtype, name="ln_embed"
+            )(x.astype(self.dtype))
         if self.dropout:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
         if self.scan_layers:
@@ -245,7 +270,7 @@ class Bert(nn.Module):
             )(
                 num_heads=self.num_heads, train=train, dtype=self.dtype,
                 attn_impl=self.attn_impl, mesh=self.mesh,
-                dropout=self.dropout, name="hs",
+                dropout=self.dropout, fused_ln=self.fused_ln, name="hs",
             )
             x, _ = scanned(x, attention_mask)
         elif self.remat_layers:
@@ -257,7 +282,8 @@ class Bert(nn.Module):
                 x = EncoderBlock(
                     self.num_heads, dtype=self.dtype,
                     attn_impl=self.attn_impl, mesh=self.mesh,
-                    dropout=self.dropout, name=f"h_{i}",
+                    dropout=self.dropout, fused_ln=self.fused_ln,
+                    name=f"h_{i}",
                 )(x, train=train, attention_mask=attention_mask)
         if return_hidden:
             return x

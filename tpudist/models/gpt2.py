@@ -46,6 +46,12 @@ class Block(nn.Module):
     # residual dropout (GPT-2 uses 0.1); needs a 'dropout' rng when > 0 and
     # train=True — tpudist.train supplies a per-step key automatically
     dropout: float = 0.0
+    # fused_ln=True swaps both LayerNorms for the Pallas fused
+    # residual-add+LN kernel (tpudist.ops.layernorm — identical param
+    # names/shapes, so checkpoints and the unfused-built TrainState drive
+    # it unchanged). The decode path keeps the reference composition (a
+    # single-token norm is launch-bound, not bandwidth-bound).
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
@@ -58,7 +64,18 @@ class Block(nn.Module):
         )
         dense_init = nn.initializers.lecun_normal()
         partitioned = _partitioned if self.tp else (lambda init, *axes: init)
-        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_1")(x)
+        fused = self.fused_ln and not decode
+        if fused:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            ln = lambda name: FusedLayerNorm(
+                epsilon=1e-5, dtype=self.dtype, mesh=self.mesh, name=name
+            )
+        else:
+            ln = lambda name: nn.LayerNorm(
+                epsilon=1e-5, dtype=self.dtype, name=name
+            )
+        y = ln("ln_1")(x)
         # column-parallel: head dim sharded over 'tensor'
         qkv = nn.DenseGeneral(
             (3, h, d // h), dtype=self.dtype, name="qkv",
@@ -123,8 +140,14 @@ class Block(nn.Module):
             d, axis=(-2, -1), dtype=self.dtype, name="out",
             kernel_init=partitioned(dense_init, TENSOR_AXIS, None, None),
         )(attn)
-        x = x + drop(y)
-        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_2")(x)
+        if fused:
+            # one kernel sweep: residual add + LN (+ the compute-dtype
+            # cast); both the normed value and the updated residual
+            # stream come back from the same HBM pass
+            y, x = ln("ln_2")(drop(y), residual=x)
+        else:
+            x = x + drop(y)
+            y = ln("ln_2")(x)
         if self.num_experts > 0:
             from tpudist.parallel.ep import MoEMlp
 
@@ -158,12 +181,14 @@ class _CarryBlock(nn.Module):
     attn_impl: str = "xla"
     mesh: Any = None
     dropout: float = 0.0
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, _):
         x = Block(
             self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
-            mesh=self.mesh, dropout=self.dropout, name="block",
+            mesh=self.mesh, dropout=self.dropout, fused_ln=self.fused_ln,
+            name="block",
         )(x, train=self.train)
         return x, None
 
@@ -200,6 +225,12 @@ class GPT2(nn.Module):
     # recompute for activation HBM without switching layouts. Ignored on
     # the decode path (the KV-cache step has no backward).
     remat_policy: str | None = None
+    # fused_ln=True runs every LayerNorm (ln_1/ln_2/ln_f) through the
+    # Pallas fused residual-add+LN kernel (tpudist.ops.layernorm) — the
+    # non-GEMM-tail lever of docs/PERF.md §4c. Same param tree as the
+    # flax modules; decode keeps the reference composition. Usually set
+    # via make_train_step(fused="ln"|"all"), which clones the model.
+    fused_ln: bool = False
 
     @property
     def has_aux_loss(self) -> bool:
@@ -309,7 +340,7 @@ class GPT2(nn.Module):
             )(
                 num_heads=self.num_heads, train=train, dtype=self.dtype,
                 attn_impl=self.attn_impl, mesh=self.mesh,
-                dropout=self.dropout, name="hs",
+                dropout=self.dropout, fused_ln=self.fused_ln, name="hs",
             )
             x, _ = scanned(x, None)
         elif self.remat_layers:
@@ -331,13 +362,21 @@ class GPT2(nn.Module):
                     self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
                     num_experts=self.num_experts if moe_here else 0,
                     moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
-                    mesh=self.mesh, dropout=self.dropout, name=f"h_{i}",
+                    mesh=self.mesh, dropout=self.dropout,
+                    fused_ln=self.fused_ln, name=f"h_{i}",
                 )(x, train, decode, self.max_seq_len,
                   # only the (remat-free) decode path threads per-slot
                   # positions; the remat wrapper's static_argnums contract
                   # stays untouched
                   **({"positions": positions} if decode else {}))
-        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
+        if self.fused_ln and not decode:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            x = FusedLayerNorm(
+                epsilon=1e-5, dtype=self.dtype, mesh=self.mesh, name="ln_f"
+            )(x)
+        else:
+            x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             # the chunked-CE path (chunked_lm_forward) applies the tied head
             # per sequence chunk so the [B,S,V] f32 logits never materialize

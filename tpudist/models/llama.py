@@ -71,6 +71,10 @@ class LlamaBlock(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # fused_ln=True runs both RMSNorms through the Pallas fused
+    # residual-add+norm kernel (tpudist.ops.layernorm, rms=True — same
+    # "scale" param as nn.RMSNorm). Decode keeps the reference composition.
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
@@ -81,9 +85,20 @@ class LlamaBlock(nn.Module):
             raise ValueError(f"num_heads {h} not divisible by num_kv_heads {kv}")
         dh = d // h
         dense_init = nn.initializers.lecun_normal()
+        fused = self.fused_ln and not decode
+        if fused:
+            from tpudist.ops.layernorm import FusedLayerNorm
 
-        y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype,
-                       name="attn_norm")(x)
+            norm = lambda name: FusedLayerNorm(
+                epsilon=self.norm_eps, dtype=self.dtype, rms=True,
+                mesh=self.mesh, name=name,
+            )
+        else:
+            norm = lambda name: nn.RMSNorm(
+                epsilon=self.norm_eps, dtype=self.dtype, name=name
+            )
+
+        y = norm("attn_norm")(x)
         # column-parallel projections: head dim sharded over 'tensor'
         q = nn.DenseGeneral((h, dh), use_bias=False, dtype=self.dtype,
                             name="q_proj",
@@ -166,13 +181,17 @@ class LlamaBlock(nn.Module):
                     mesh=self.mesh,
                 )
         # row-parallel output projection; GSPMD all-reduces over 'tensor'
-        x = x + nn.DenseGeneral(
+        o = nn.DenseGeneral(
             d, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="o_proj",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
         )(attn)
-
-        y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype,
-                       name="mlp_norm")(x)
+        if fused:
+            # residual add + RMSNorm in one kernel sweep; the updated
+            # residual stream rides back from the same HBM pass
+            y, x = norm("mlp_norm")(o, residual=x)
+        else:
+            x = x + o
+            y = norm("mlp_norm")(x)
         if self.num_experts > 0:
             from tpudist.parallel.ep import MoEMlp
 
@@ -211,6 +230,7 @@ class _CarryBlock(nn.Module):
     rope_theta: float = 10000.0
     mesh: Any = None
     norm_eps: float = 1e-5
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, _):
@@ -218,7 +238,7 @@ class _CarryBlock(nn.Module):
             self.num_heads, self.num_kv_heads, self.ffn_dim,
             dtype=self.dtype, attn_impl=self.attn_impl,
             rope_theta=self.rope_theta, mesh=self.mesh,
-            norm_eps=self.norm_eps, name="block",
+            norm_eps=self.norm_eps, fused_ln=self.fused_ln, name="block",
         )(x, train=self.train)
         return x, None
 
@@ -271,6 +291,11 @@ class Llama(nn.Module):
     moe_every: int = 1  # Mixtral: every block is MoE
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # fused_ln=True: every RMSNorm (attn_norm/mlp_norm/final norm) runs
+    # the Pallas fused residual-add+norm kernel (tpudist.ops.layernorm,
+    # rms=True) — same param tree, decode path untouched. Usually set via
+    # make_train_step(fused="ln"|"all"), which clones the model.
+    fused_ln: bool = False
 
     @property
     def has_aux_loss(self) -> bool:
@@ -309,7 +334,7 @@ class Llama(nn.Module):
             num_heads=self.num_heads, num_kv_heads=kv, ffn_dim=ffn,
             dtype=self.dtype, attn_impl=self.attn_impl,
             rope_theta=self.rope_theta, mesh=self.mesh,
-            norm_eps=self.norm_eps,
+            norm_eps=self.norm_eps, fused_ln=self.fused_ln,
         )
         from tpudist.remat import remat_module
 
@@ -361,7 +386,17 @@ class Llama(nn.Module):
                   # only the (remat-free) decode path threads per-slot
                   # positions (same contract as GPT-2)
                   **({"positions": positions} if decode else {}))
-        x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")(x)
+        if self.fused_ln and not decode:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            x = FusedLayerNorm(
+                epsilon=self.norm_eps, dtype=self.dtype, rms=True,
+                mesh=self.mesh, name="norm",
+            )(x)
+        else:
+            x = nn.RMSNorm(
+                epsilon=self.norm_eps, dtype=self.dtype, name="norm"
+            )(x)
         if return_hidden:
             # the chunked-CE path applies the head per sequence chunk so the
             # [B,S,V] fp32 logits never materialize (gpt2.chunked_lm_forward)

@@ -171,4 +171,9 @@ def chunked_lm_forward(model, chunk: int = 256):
         )
         return total / (b * s), batch_stats
 
+    # the hook make_train_step(fused="ln") uses to re-close this loss over
+    # its fused_ln model clone (the closure above captured `model`; a
+    # cloned model would otherwise never reach the forward)
+    forward_loss.rebuild = lambda m: chunked_lm_forward(m, chunk=chunk)
+    forward_loss.model = model
     return forward_loss

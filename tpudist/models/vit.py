@@ -48,6 +48,10 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0
     mesh: Any = None  # multi-chip Pallas attention (shard_map wrap)
+    # fused_ln=True: both pre-LNs run the Pallas fused residual-add+LN
+    # kernel (tpudist.ops.layernorm) under the flax auto-names
+    # ("LayerNorm_0"/"LayerNorm_1"), so the param tree is unchanged
+    fused_ln: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -58,7 +62,17 @@ class EncoderBlock(nn.Module):
             if self.dropout else y
         )
         dense_init = nn.initializers.lecun_normal()
-        y = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.fused_ln:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            # explicit names pin the flax auto-numbering the unfused
+            # modules would have received
+            ln = lambda name: FusedLayerNorm(
+                epsilon=1e-6, dtype=self.dtype, mesh=self.mesh, name=name
+            )
+        else:
+            ln = lambda name: nn.LayerNorm(dtype=self.dtype, name=name)
+        y = ln("LayerNorm_0")(x)
         qkv = nn.DenseGeneral(
             (3, h, d // h), dtype=self.dtype, name="qkv",
             kernel_init=_partitioned(dense_init, None, None, TENSOR_AXIS, None),
@@ -71,8 +85,12 @@ class EncoderBlock(nn.Module):
             d, axis=(-2, -1), dtype=self.dtype, name="out",
             kernel_init=_partitioned(dense_init, TENSOR_AXIS, None, None),
         )(attn)
-        x = x + drop(y)
-        y = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.fused_ln:
+            # residual add + LN in one kernel sweep (pre-norm composition)
+            y, x = ln("LayerNorm_1")(drop(y), residual=x)
+        else:
+            x = x + drop(y)
+            y = ln("LayerNorm_1")(x)
         return x + drop(MlpBlock(self.mlp_dim, dtype=self.dtype)(y))
 
 
@@ -87,6 +105,10 @@ class ViT(nn.Module):
     attn_impl: str = "xla"
     dropout: float = 0.0  # residual dropout; rng plumbed by tpudist.train
     mesh: Any = None  # multi-chip Pallas attention (shard_map wrap)
+    # fused_ln=True: every encoder LN + the final LN run the Pallas fused
+    # residual-add+LN kernel (tpudist.ops.layernorm); param tree unchanged.
+    # Usually set via make_train_step(fused="ln"|"all") / main.py --fused.
+    fused_ln: bool = False
 
     @property
     def flops_counter(self) -> str | None:
@@ -115,9 +137,17 @@ class ViT(nn.Module):
             x = EncoderBlock(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, dropout=self.dropout,
-                mesh=self.mesh, name=f"block_{i}",
+                mesh=self.mesh, fused_ln=self.fused_ln, name=f"block_{i}",
             )(x, train=train)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.fused_ln:
+            from tpudist.ops.layernorm import FusedLayerNorm
+
+            x = FusedLayerNorm(
+                epsilon=1e-6, dtype=self.dtype, mesh=self.mesh,
+                name="LayerNorm_0",
+            )(x)
+        else:
+            x = nn.LayerNorm(dtype=self.dtype, name="LayerNorm_0")(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +69,8 @@ def make_optimizer(
     weight_decay: float = 0.0,
     clip_norm: float | None = None,
     skip_nonfinite_updates: bool = False,
+    fused: bool = False,
+    compute_dtype: Any = None,
 ) -> optax.GradientTransformation:
     """One-stop optimizer factory.
 
@@ -78,9 +80,31 @@ def make_optimizer(
     ``clip_norm`` prepends global-norm clipping;
     ``skip_nonfinite_updates`` wraps the chain in
     :func:`tpudist.amp.skip_nonfinite`.
+
+    ``fused=True`` builds :func:`fused_adamw` instead — the one-pass
+    Pallas update kernel with bit-compatible math (``optimizer="adam"``
+    only; clipping/decay/mask/skip all compose). ``compute_dtype`` (with
+    ``fused``) keeps the in-state compute-precision param copy the fused
+    train step's forward reads (``make_train_step(fused=...)``).
     """
     if b2 is None:
         b2 = 0.99 if optimizer == "lion" else 0.999
+    if fused:
+        if optimizer != "adam":
+            raise ValueError(
+                f"fused=True implements the adam/adamw update only, got "
+                f"optimizer={optimizer!r}"
+            )
+        tx = fused_adamw(
+            lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+            mask=decay_mask if weight_decay > 0.0 else None,
+            clip_norm=clip_norm, compute_dtype=compute_dtype,
+        )
+        if skip_nonfinite_updates:
+            from tpudist.amp import skip_nonfinite
+
+            tx = skip_nonfinite(tx)
+        return tx
     parts = []
     if clip_norm is not None:
         parts.append(optax.clip_by_global_norm(clip_norm))
@@ -349,3 +373,276 @@ def shard_state(
         init=init, update=update, state_shardings=state_shardings,
         inner=tx, mesh=mesh, axis=axis,
     )
+
+
+# --------------------------------------------------------------------------
+# Fused one-pass AdamW (tpudist.ops.fused_update) — the non-GEMM-tail lever
+# --------------------------------------------------------------------------
+#
+# docs/PERF.md §4b measured the 124M step's residual as the serial
+# elementwise tail BETWEEN the matmuls; the optax Adam chain (moment pass,
+# bias correction, decayed weights, lr scale) plus the per-step fp32→bf16
+# param casts are the optimizer's share of it. fused_adamw runs the whole
+# update as ONE Pallas sweep per leaf — read (g, m, v, p), write (m', v',
+# update, bf16 compute copy) — behind the standard optax (init, update)
+# surface, so everything that composes with an optimizer here (ZeRO-1
+# shard_state, amp.skip_nonfinite, make_train_step's guard_nonfinite,
+# telemetry's norms) composes with it unchanged.
+
+
+class FusedAdamWState(NamedTuple):
+    """State of :func:`fused_adamw`. ``compute`` is the params-shaped
+    compute-dtype copy (written by the kernel in the same sweep as the
+    moments) or the EMPTY tuple when ``compute_dtype`` is off — zero
+    leaves, so checkpoints/shardings of copy-less states carry nothing
+    extra (the ``TrainState.comm_residual`` convention)."""
+
+    count: Any
+    mu: Any
+    nu: Any
+    compute: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedAdamW:
+    """Duck-typed ``(init, update)`` optimizer running the one-pass fused
+    AdamW kernel (:mod:`tpudist.ops.fused_update`). Built by
+    :func:`fused_adamw`; detected through wrappers (``shard_state``,
+    ``amp.skip_nonfinite`` — both expose ``inner``) by
+    :func:`find_fused`."""
+
+    init: Callable
+    update: Callable
+    compute_dtype: Any
+    learning_rate: Any
+    weight_decay: float
+
+
+def fused_adamw(
+    learning_rate: float | optax.Schedule = 1e-3,
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask: Callable | None = None,
+    clip_norm: float | None = None,
+    compute_dtype: Any = None,
+    min_kernel_elems: int | None = None,
+) -> FusedAdamW:
+    """One-pass fused AdamW with an optax-compatible surface.
+
+    Matches ``optax.adamw(lr, b1, b2, eps, weight_decay, mask=mask)``
+    (and plain ``optax.adam`` at ``weight_decay=0``) BIT-FOR-BIT in
+    interpret mode — same division-form bias correction, same
+    ``√v̂ + eps`` denominator, same decay-then-scale order
+    (tests/test_fused_update.py pins it) — while collapsing the chain's
+    per-transform tree passes into one HBM sweep per leaf.
+
+    ``mask``: callable ``params → tree of static bools`` selecting decayed
+    leaves (:func:`decay_mask`); ``None`` decays everything (optax's
+    convention). ``clip_norm`` prepends ``clip_by_global_norm`` with
+    optax's exact arithmetic (the global norm is one tree reduction XLA
+    fuses with the backward; the scale rides into the kernel's read of
+    ``g``). ``learning_rate`` may be a schedule (called on the
+    pre-increment step count, optax's convention).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) adds a params-shaped compute
+    copy to the state, refreshed by the kernel in the same sweep as the
+    moments: ``compute = compute_dtype(p + update)``, bit-identical to
+    casting the post-update master. ``make_train_step(fused=...)`` routes
+    the next step's forward through it, which deletes the per-step
+    fp32→bf16 cast of every parameter AND halves the forward's param-read
+    bytes. Float leaves cast; non-float leaves ride along unchanged.
+
+    ZeRO-1: apply ``tpudist.optim.shard_state`` AROUND this (the usual
+    order) — the update math runs on the restored layout; on the CPU
+    interpret path the kernel decomposes into partitionable ops and runs
+    on the 1/W shard, on real TPUs measure before combining (pallas_call
+    has no GSPMD rule — see tpudist.ops.fused_update's module docstring).
+    """
+    from tpudist.ops.fused_update import fused_leaf_update
+
+    def _cast_copy(p):
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+            return jnp.asarray(p, compute_dtype)
+        return p
+
+    def init(params):
+        # zeros_like/astype map over the INNER arrays of nn.Partitioned
+        # boxes (they are pytree nodes), so a boxed init — what
+        # create_train_state runs — yields moments/copy carrying the same
+        # partitioning metadata as the params, like optax.adam's would
+        zeros = lambda tree: jax.tree_util.tree_map(jnp.zeros_like, tree)
+        compute = (
+            jax.tree_util.tree_map(_cast_copy, params)
+            if compute_dtype is not None else ()
+        )
+        return FusedAdamWState(
+            count=jnp.zeros((), jnp.int32),
+            mu=zeros(params), nu=zeros(params), compute=compute,
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError(
+                "fused_adamw requires params at update time (weight decay "
+                "and the compute copy read them); tpudist's train step "
+                "always passes them"
+            )
+        if clip_norm is not None:
+            # optax.clip_by_global_norm's exact arithmetic (divide by the
+            # norm, then scale by the max) so the fused chain stays
+            # bit-compatible with the unfused one
+            g_norm = optax.global_norm(grads)
+            grads = jax.tree_util.tree_map(
+                lambda t: jnp.where(
+                    g_norm < clip_norm, t,
+                    (t / g_norm.astype(t.dtype)) * clip_norm,
+                ),
+                grads,
+            )
+        count_inc = optax.safe_int32_increment(state.count)
+        b1c = 1.0 - b1 ** count_inc.astype(jnp.float32)
+        b2c = 1.0 - b2 ** count_inc.astype(jnp.float32)
+        lr_t = (
+            learning_rate(state.count) if callable(learning_rate)
+            else learning_rate
+        )
+        lr_t = jnp.asarray(lr_t, jnp.float32)
+
+        mask_tree = mask(params) if mask is not None else None
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        m_leaves = treedef.flatten_up_to(state.mu)
+        v_leaves = treedef.flatten_up_to(state.nu)
+        wd_leaves = (
+            treedef.flatten_up_to(mask_tree) if mask_tree is not None
+            else [True] * len(p_leaves)
+        )
+        results = [
+            fused_leaf_update(
+                g, m, v, p, lr_t, b1c, b2c, b1=b1, b2=b2, eps=eps,
+                wd=weight_decay if decayed else 0.0,
+                compute_dtype=(
+                    compute_dtype if compute_dtype is not None
+                    and jnp.issubdtype(p.dtype, jnp.floating) else None
+                ),
+                **({} if min_kernel_elems is None
+                   else {"min_kernel_elems": min_kernel_elems}),
+            )
+            for g, m, v, p, decayed in zip(
+                g_leaves, m_leaves, v_leaves, p_leaves, wd_leaves
+            )
+        ]
+        updates = treedef.unflatten([r[0] for r in results])
+        new_state = FusedAdamWState(
+            count=count_inc,
+            mu=treedef.unflatten([r[1] for r in results]),
+            nu=treedef.unflatten([r[2] for r in results]),
+            compute=(
+                treedef.unflatten([
+                    r[3] if r[3] is not None else p
+                    for r, p in zip(results, p_leaves)
+                ])
+                if compute_dtype is not None else ()
+            ),
+        )
+        return updates, new_state
+
+    return FusedAdamW(
+        init=init, update=update, compute_dtype=compute_dtype,
+        learning_rate=learning_rate, weight_decay=weight_decay,
+    )
+
+
+def find_fused(tx) -> FusedAdamW | None:
+    """The :class:`FusedAdamW` inside ``tx``, walking the wrappers that
+    expose ``inner`` (:class:`ShardedStateOptimizer`,
+    ``amp.SkipNonfinite``) — or ``None``. An ``optax.chain`` hides its
+    members, so a chained fused optimizer keeps the kernel update but is
+    invisible to the compute-copy wiring; build clipping into
+    :func:`fused_adamw` (``clip_norm=``) instead of chaining."""
+    seen = 0
+    while tx is not None and seen < 8:
+        if isinstance(tx, FusedAdamW):
+            return tx
+        tx = getattr(tx, "inner", None)
+        seen += 1
+    return None
+
+
+def _fused_state_in(opt_state):
+    from tpudist.amp import is_skip_state
+
+    if isinstance(opt_state, FusedAdamWState):
+        return opt_state
+    if is_skip_state(opt_state):
+        return _fused_state_in(opt_state[0])
+    if isinstance(opt_state, (tuple, list)) and not hasattr(
+        opt_state, "_fields"
+    ):
+        for el in opt_state:
+            found = _fused_state_in(el)
+            if found is not None:
+                return found
+    return None
+
+
+def _copy_matches(compute, params) -> bool:
+    c_leaves = jax.tree_util.tree_leaves(compute)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    if not c_leaves or len(c_leaves) != len(p_leaves):
+        return False
+    if jax.tree_util.tree_structure(compute) != jax.tree_util.tree_structure(
+        params
+    ):
+        return False
+    return all(
+        getattr(c, "shape", None) == getattr(p, "shape", None)
+        for c, p in zip(c_leaves, p_leaves)
+    )
+
+
+def fused_compute_params(opt_state, params):
+    """The compute-dtype param copy carried by a :func:`fused_adamw` state,
+    or ``None`` when absent/unusable. Usable means: reachable through the
+    known wrappers AND params-shaped leaf-for-leaf — under ZeRO-1 a
+    pad-and-reshape-stored leaf breaks the shape match and the whole copy
+    is declined (the forward then reads the masters; a stale or re-laid-out
+    copy can never be silently used). Static structure/shape checks only —
+    free at trace time."""
+    st = _fused_state_in(opt_state)
+    if st is None:
+        return None
+    if not _copy_matches(st.compute, params):
+        return None
+    return st.compute
+
+
+def refresh_fused_compute(opt_state, params):
+    """Re-cast the fused compute copy from ``params`` wherever it is
+    reachable and params-shaped — fit()'s warm-start hook (``init_params``
+    replaces the masters AFTER ``tx.init`` built the copy; without the
+    refresh the copy would describe the discarded random init). States
+    without a usable copy pass through unchanged, which is safe: the same
+    shape predicate gates :func:`fused_compute_params`, so an unrefreshed
+    copy is also an unused one."""
+    if isinstance(opt_state, FusedAdamWState):
+        if not _copy_matches(opt_state.compute, params):
+            return opt_state
+        fresh = jax.tree_util.tree_map(
+            lambda p, c: jnp.asarray(p, c.dtype), params, opt_state.compute
+        )
+        return opt_state._replace(compute=fresh)
+    from tpudist.amp import is_skip_state
+
+    if is_skip_state(opt_state):
+        inner = refresh_fused_compute(opt_state[0], params)
+        return opt_state if inner is opt_state[0] else (inner, opt_state[1])
+    if isinstance(opt_state, tuple) and not hasattr(opt_state, "_fields"):
+        refreshed = tuple(refresh_fused_compute(el, params) for el in opt_state)
+        if all(a is b for a, b in zip(refreshed, opt_state)):
+            return opt_state  # nothing fused inside: identity, not a rebuild
+        return refreshed
+    return opt_state

@@ -167,8 +167,10 @@ class TelemetrySink:
     <kind-specific fields>}``. Kinds written by ``fit()``: ``health``,
     ``step_breakdown``, ``mfu``, ``throughput``, ``memory``, ``anomaly``,
     ``heartbeat``, ``train_time``, ``run_meta``, ``comm`` (explicit
-    gradient reduction's one-time wire accounting), ``warning`` (tagged
-    one-shot diagnoses, e.g. ``h2d_link_bound``). The serving engine
+    gradient reduction's one-time wire accounting), ``fusion`` (one-time
+    step-fusion config: which Pallas kernels — fused LN, fused optimizer
+    — the compiled step engaged, and the compute-copy dtype), ``warning``
+    (tagged one-shot diagnoses, e.g. ``h2d_link_bound``). The serving engine
     (``tpudist.serve``) writes ``serve``/``serve_summary`` SLO rows
     through the same sink. Schema glossary in docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
     APPEND mode — both halves of the flight-recorder contract: the anomaly
@@ -482,6 +484,16 @@ class Telemetry:
             self.process_index = int(rank)
 
     # -- wiring ------------------------------------------------------------
+
+    def set_fusion(self, info: Mapping[str, Any]) -> None:
+        """One-time ``fusion`` row (rank 0): the step-fusion layer's
+        resolved configuration (``make_train_step``'s ``step.fused_info``
+        — ``ln``/``optimizer`` booleans + ``compute_dtype``), written at
+        bring-up so every throughput/mfu row in the stream is attributable
+        to the kernel set that produced it. Not written unless ``fit`` got
+        a ``fused=`` request — streams stay byte-identical otherwise."""
+        if self.rank == 0:
+            self.sink.write("fusion", **dict(info))
 
     def set_comm(self, stats: Mapping[str, Any] | None,
                  probe_s: float | None = None) -> None:

@@ -189,6 +189,56 @@ def _apply_input_transform(transform, inputs, batch, step=None):
     return transform(*args)
 
 
+def resolve_fused(fused, model, tx) -> frozenset:
+    """Resolve a ``fused=`` request against what the model/optimizer
+    support — the ONE mapping both :func:`make_train_step` and ``fit``
+    (via the step's ``fused_info``) rely on.
+
+    ``None``/``False``/``"none"`` → nothing (programs bit-identical to the
+    pre-fusion rounds). ``"auto"`` → every fusion that is AVAILABLE: the
+    Pallas LN path when the model exposes a ``fused_ln`` knob (the
+    GPT-2/Llama/BERT/ViT families), the fused-optimizer forward wiring
+    when ``tx`` carries a :func:`tpudist.optim.fused_adamw` (directly or
+    under ``shard_state``/``skip_nonfinite``). ``"ln"``/``"optimizer"``
+    demand exactly one side and raise when unsupported — a request that
+    silently did nothing would be a benchmark lying about its
+    configuration. ``"all"`` demands both.
+    """
+    if not fused or fused == "none":
+        return frozenset()
+    if fused is True:
+        fused = "auto"
+    if fused not in ("auto", "ln", "optimizer", "all"):
+        raise ValueError(
+            f"fused={fused!r}: expected None/'none'/'auto'/'ln'/"
+            "'optimizer'/'all'"
+        )
+    from tpudist.optim import find_fused
+
+    ln_ok = hasattr(model, "fused_ln")
+    opt_ok = find_fused(tx) is not None
+    out = set()
+    if fused in ("ln", "all") or (fused == "auto" and ln_ok):
+        if not ln_ok:
+            raise ValueError(
+                f"fused={fused!r} requests the fused LN path but "
+                f"{type(model).__name__} has no fused_ln knob (the "
+                "GPT-2/Llama/BERT/ViT families carry it)"
+            )
+        out.add("ln")
+    if fused in ("optimizer", "all") or (fused == "auto" and opt_ok):
+        if not opt_ok:
+            raise ValueError(
+                f"fused={fused!r} requests the fused-optimizer path but "
+                "the optimizer chain carries no tpudist.optim.fused_adamw "
+                "(build one via make_optimizer(fused=True) or "
+                "optim.fused_adamw; shard_state/skip_nonfinite wrappers "
+                "are looked through)"
+            )
+        out.add("optimizer")
+    return frozenset(out)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -209,6 +259,7 @@ def make_train_step(
     reduce: Any = "none",
     reduce_bucket_size: int | None = None,
     error_feedback: bool = True,
+    fused: str | bool | None = None,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
 
@@ -293,6 +344,22 @@ def make_train_step(
     (``True`` ≡ ``"full"``). This wraps the WHOLE forward; per-block
     checkpointing — the stronger memory lever for deep models — is the
     model zoo's ``remat_policy`` field, same policy names.
+
+    ``fused`` selects the step-fusion layer attacking the measured
+    non-GEMM tail (docs/PERF.md §4c): ``"ln"`` clones the model with
+    ``fused_ln=True`` (the Pallas fused residual-add+LayerNorm kernel in
+    every block, ``tpudist.ops.layernorm``), ``"optimizer"`` routes the
+    forward through the compute-dtype param copy a
+    ``tpudist.optim.fused_adamw`` keeps in its state (deleting the
+    per-step fp32→bf16 param casts; gradients then arrive in the compute
+    dtype — the standard mixed-precision trade, exact when the compute
+    dtype IS fp32), ``"all"`` both, ``"auto"`` whatever the model/tx
+    support, ``None`` (default) nothing — programs bit-identical to
+    before. The resolved set rides ``step.fused`` / ``step.fused_info``
+    (fit's telemetry ``fusion`` row). With a custom ``forward_loss``,
+    ``"ln"`` needs the loss builder's ``rebuild`` hook
+    (``chunked_lm_forward`` carries one) so the fused clone actually
+    reaches the forward.
     """
     batch_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
@@ -336,6 +403,58 @@ def make_train_step(
                     f"got param shardings {bad[:3]} — TP/FSDP models keep "
                     "the implicit XLA reduction"
                 )
+
+    fused_set = resolve_fused(fused, model, tx)
+    if ("ln" in fused_set and not getattr(model, "fused_ln", False)
+            and forward_loss is not None
+            and getattr(forward_loss, "rebuild", None) is None):
+        # a custom forward_loss closure captured the UNFUSED model and
+        # exposes no way to re-close over the fused clone. Under "auto"
+        # (best-effort by contract) the LN side simply isn't available —
+        # decline it with a warning; an explicit request must not
+        # silently run unfused, so it raises.
+        if fused in ("auto", True):
+            import warnings
+
+            warnings.warn(
+                "fused='auto': declining LN fusion — forward_loss has no "
+                ".rebuild(model) hook, so the fused model clone cannot "
+                "reach the forward (chunked_lm_forward carries the hook; "
+                "or build forward_loss from a fused_ln=True model)"
+            )
+            fused_set = fused_set - {"ln"}
+        else:
+            raise ValueError(
+                "fused LN needs the forward to run the CLONED model, "
+                "but this forward_loss closure captured the unfused "
+                "one and exposes no .rebuild(model) hook — build it "
+                "from a fused_ln=True model yourself, or use "
+                "chunked_lm_forward (which carries the hook)"
+            )
+    if "ln" in fused_set and not getattr(model, "fused_ln", False):
+        # same params, same names — fused_ln only swaps the LN modules for
+        # their kernel twins, so the state built from the unfused model
+        # drives this clone unchanged
+        model = model.clone(fused_ln=True)
+        if forward_loss is not None:
+            forward_loss = forward_loss.rebuild(model)
+    if "optimizer" in fused_set:
+        from tpudist.optim import find_fused as _find_fused
+
+        _fused_tx = _find_fused(tx)
+        fused_info = {
+            "ln": "ln" in fused_set,
+            "optimizer": True,
+            "compute_dtype": (
+                None if _fused_tx.compute_dtype is None
+                else jnp.dtype(_fused_tx.compute_dtype).name
+            ),
+        }
+    else:
+        fused_info = {
+            "ln": "ln" in fused_set, "optimizer": False,
+            "compute_dtype": None,
+        }
 
     # models that sow auxiliary losses (e.g. MoE load-balance,
     # parallel/ep.py) declare it via ``has_aux_loss``; duck-typed models
@@ -401,6 +520,19 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch):
         new_residual = state.comm_residual
+        # fused-optimizer forward wiring: the forward reads the compute-
+        # dtype copy fused_adamw wrote in LAST step's update sweep (==
+        # compute_dtype(current params), never stale), deleting the
+        # per-op fp32→compute casts and halving the forward's param-read
+        # bytes. Declined (masters used) whenever the copy is absent or
+        # not params-shaped — e.g. ZeRO-1 pad-stored leaves.
+        fwd_params = state.params
+        if "optimizer" in fused_set:
+            from tpudist.optim import fused_compute_params
+
+            copy = fused_compute_params(state.opt_state, state.params)
+            if copy is not None:
+                fwd_params = copy
         if reducer is not None:
             bad_keys = sorted(k for k in batch if k.startswith("_"))
             if bad_keys:
@@ -411,14 +543,14 @@ def make_train_step(
                     "with DeviceCachedLoader"
                 )
             loss, grads, new_stats, ef_res = reducer.compute(
-                grad_fn, state.params, state.batch_stats, batch, state.step,
+                grad_fn, fwd_params, state.batch_stats, batch, state.step,
                 state.comm_residual, grad_accum,
             )
             if ef_res is not None:
                 new_residual = ef_res
         elif grad_accum == 1:
             (loss, new_stats), grads = grad_fn(
-                state.params, state.batch_stats, batch, state.step
+                fwd_params, state.batch_stats, batch, state.step
             )
         else:
             # "_"-prefixed keys are per-step operands (e.g. the
@@ -434,7 +566,7 @@ def make_train_step(
                 gsum, stats, lsum = carry
                 # distinct dropout stream per microbatch
                 (l, stats), g = grad_fn(
-                    state.params, stats, {**mb, **operands},
+                    fwd_params, stats, {**mb, **operands},
                     state.step * grad_accum + i
                 )
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
@@ -590,6 +722,8 @@ def make_train_step(
         None if reducer is None
         else lambda params: reducer.comm_stats(params, grad_accum)
     )
+    compiled.fused = fused_set
+    compiled.fused_info = fused_info
     return compiled
 
 
@@ -612,6 +746,7 @@ def fit(
     remat: bool | str = False,
     shard_opt_state: bool = False,
     reduce: str = "none",
+    fused: str | None = None,
     batch_spec: Mapping[str, P] | None = None,
     forward_loss: Callable | None = None,
     input_transform: Callable | None = None,
@@ -703,6 +838,14 @@ def fit(
     comm-time probe into the JSONL sink (a ``comm`` column on the step-time
     breakdown rows; rows are unchanged when the feature is off).
 
+    ``fused`` selects the step-fusion layer (see :func:`make_train_step`
+    and docs/PERF.md §4c): ``"ln"`` / ``"optimizer"`` / ``"all"`` /
+    ``"auto"``; ``None`` (default) keeps the compiled programs
+    bit-identical to previous rounds. With telemetry on, the resolved
+    configuration is recorded as a one-time ``fusion`` JSONL row so bench
+    records and run reports stay attributable to the kernels that
+    actually ran.
+
     ``shard_opt_state=True`` wraps ``tx`` in ZeRO-1 cross-replica
     optimizer-state sharding (``tpudist.optim.shard_state``): the Adam
     mirrors live sharded over the ``data`` replicas (~1/world_size per
@@ -762,7 +905,15 @@ def fit(
             ),
             state.params, init_params,
         )
-        state = state.replace(params=placed)
+        from tpudist.optim import refresh_fused_compute
+
+        # a fused_adamw compute copy was cast from the DISCARDED random
+        # init — re-cast it from the warm-start weights (no-op for states
+        # without a usable copy, which the forward also never reads)
+        state = state.replace(
+            params=placed,
+            opt_state=refresh_fused_compute(state.opt_state, placed),
+        )
     # DDP verifies rank param consistency at wrap time (main.py:83); same
     # check here — same seed must have produced identical params (no-op
     # single-process)
@@ -782,7 +933,7 @@ def fit(
         loss_fn=loss_fn, input_key=input_key, label_key=label_key,
         grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
         forward_loss=forward_loss, dropout_seed=seed,
-        input_transform=input_transform, reduce=reduce,
+        input_transform=input_transform, reduce=reduce, fused=fused,
         **(tel_cfg.step_kwargs() if tel_cfg else {}),
         # keep whatever sharding create_train_state produced (replicated for
         # plain DP, sharded for TP-annotated models) — forcing replicated
@@ -918,6 +1069,11 @@ def fit(
                     # this generation will overwrite
                     gp.load_previous(tel.health.report_path)
                 logger.attach_sink(tel.sink)
+                if fused is not None:
+                    # one-time fusion config row: which kernels this run's
+                    # compiled step actually engaged — the attribution a
+                    # bench record or run report needs next to its numbers
+                    tel.set_fusion(step.fused_info)
                 if step.grad_reducer is not None:
                     # one-time comm accounting + a measured standalone
                     # probe of the reduce-only program: the `comm` column
