@@ -112,7 +112,13 @@ Legs
    recovery cost in wall seconds (emergency save + restart gap + resumed
    generation's bring-up/restore/compile — ``goodput.cumulative
    .restart_overhead_s`` from the run report); vs_baseline = target /
-   value, so >= 1.0 means recovery lands under the bound.
+   value, so >= 1.0 means recovery lands under the bound. The leg runs
+   the drill TWICE — cold (no AOT cache) and warm (``compile_cache=``:
+   generation 0 stores the serialized step executable, generation 1
+   deserializes it instead of tracing) — and records the warm overhead
+   plus the ``vs_cold`` ratio and the goodput breakdown of both, since
+   compile is the dominant recurring restart term the cache exists to
+   delete (tpudist/compile_cache.py).
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -1695,6 +1701,9 @@ fit(
     telemetry=cfg,
     checkpoint_dir=os.path.join(out, "ckpt"), checkpoint_every=5,
     chaos="sigterm@10",
+    # the warm half of the cold-vs-warm A/B: generation 0 misses and
+    # stores the AOT executable, generation 1 loads it instead of tracing
+    compile_cache=os.environ.get("COMPILE_CACHE") or None,
 )
 """
 
@@ -1711,36 +1720,58 @@ def bench_preempt_recovery() -> None:
     import sys
     import tempfile
 
-    out = pathlib.Path(tempfile.mkdtemp(prefix="tpudist_preempt_bench_"))
-    script = out / "child.py"
-    script.write_text(_PREEMPT_CHILD)
-    env = dict(os.environ)
-    env["OUT_DIR"] = str(out)
-    repo = os.path.dirname(os.path.abspath(__file__))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    t0 = time.perf_counter()
-    r = subprocess.run(
-        [
-            sys.executable, "-m", "tpudist.launch",
-            "--nproc_per_node=1", "--max_restarts=0",
-            f"--master_port={29500 + os.getpid() % 499 + 1}",
-            str(script),
-        ],
-        cwd=repo, env=env, capture_output=True, text=True, timeout=2100,
-    )
-    wall = time.perf_counter() - t0
-    if r.returncode != 0:
-        raise RuntimeError(
-            f"preempt-recovery drill failed rc={r.returncode}:\n"
-            f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    def drill(compile_cache: str | None):
+        out = pathlib.Path(tempfile.mkdtemp(prefix="tpudist_preempt_bench_"))
+        script = out / "child.py"
+        script.write_text(_PREEMPT_CHILD)
+        env = dict(os.environ)
+        env["OUT_DIR"] = str(out)
+        env["COMPILE_CACHE"] = compile_cache or ""
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "tpudist.launch",
+                "--nproc_per_node=1", "--max_restarts=0",
+                f"--master_port={29500 + os.getpid() % 499 + 1}",
+                str(script),
+            ],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=2100,
         )
-    report = json.loads((out / "PreemptBench_report.json").read_text())
+        wall = time.perf_counter() - t0
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"preempt-recovery drill failed rc={r.returncode}:\n"
+                f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+            )
+        report = json.loads((out / "PreemptBench_report.json").read_text())
+        good = report["goodput"]
+        gens = good["generations"]
+        assert report["generation"] == 1 and len(gens) == 2, report
+        return report, wall
+
+    # cold leg: every restart re-pays the trace+compile (the pre-cache
+    # contract, and the published metric's definition)
+    report, wall = drill(None)
     good = report["goodput"]
     cum = good["cumulative"]
     gens = good["generations"]
-    assert report["generation"] == 1 and len(gens) == 2, report
     recovery_s = cum["restart_overhead_s"]
     resumed = gens[1]
+
+    # warm leg: same drill with the AOT executable cache — generation 0
+    # stores at bring-up, generation 1 deserializes instead of tracing
+    warm_cache = pathlib.Path(
+        tempfile.mkdtemp(prefix="tpudist_preempt_cc_")
+    )
+    warm_report, warm_wall = drill(str(warm_cache))
+    warm_good = warm_report["goodput"]
+    warm_gens = warm_good["generations"]
+    warm_resumed = warm_gens[1]
+    warm_recovery_s = warm_good["cumulative"]["restart_overhead_s"]
+    assert warm_resumed.get("warm_start"), warm_resumed
+
     _record_line(
         {
             "metric": "gpt2_124m_preempt_recovery_s",
@@ -1768,6 +1799,25 @@ def bench_preempt_recovery() -> None:
             "cumulative_productive_frac": cum["productive_frac"],
             "vs_baseline": round(
                 TARGET_PREEMPT_RECOVERY_S / max(recovery_s, 1e-9), 4
+            ),
+            # the cold-vs-warm A/B: the same drill with the AOT
+            # executable cache (tpudist.compile_cache). vs_cold =
+            # cold/warm restart overhead — > 1.0 means the cache bought
+            # its keep; the breakdown shows WHERE (resumed compile_s →
+            # cache_load_s)
+            "warm_restart_overhead_s": round(warm_recovery_s, 2),
+            "vs_cold": round(
+                recovery_s / max(warm_recovery_s, 1e-9), 4
+            ),
+            "cold_resume_compile_s": round(resumed["compile_s"], 3),
+            "warm_resume_compile_s": round(
+                warm_resumed["compile_s"], 3
+            ),
+            "warm_resume_cache_load_s": round(
+                warm_resumed.get("cache_load_s", 0.0), 3
+            ),
+            "warm_resume_bringup_restore_s": round(
+                warm_resumed["bringup_s"] + warm_resumed["restore_s"], 3
             ),
         }
     )
@@ -1909,7 +1959,7 @@ _LEG_GROUPS = {
     "health": (bench_run_health, 1800),
     # two full trainer generations (the resumed one recompiles through
     # the persistent cache) + the supervised relaunch between them
-    "preempt": (bench_preempt_recovery, 2400),
+    "preempt": (bench_preempt_recovery, 4500),
 }
 
 

@@ -158,6 +158,15 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint_dir", default=None, type=str)
     p.add_argument("--checkpoint_every", default=0, type=int)
     p.add_argument("--no_resume", action="store_true")
+    p.add_argument("--elastic", action="store_true",
+                   help="resume a checkpoint written at a different world "
+                   "size: ZeRO-1 shards reshard onto the live mesh "
+                   "(docs/MULTIHOST.md 'Resuming on a different world "
+                   "size')")
+    p.add_argument("--compile_cache", default=None, type=str,
+                   help="AOT executable cache dir (tpudist.compile_cache) "
+                   "— a relaunched run deserializes its compiled step "
+                   "instead of re-tracing")
     return p.parse_args(argv)
 
 
@@ -434,6 +443,8 @@ def main(argv=None):
             profile=not args.no_profiler, log_dir=args.log_dir,
             telemetry=args.telemetry,
             checkpoint_dir=args.checkpoint_dir,
+            elastic=args.elastic,
+            compile_cache=args.compile_cache,
             checkpoint_every=args.checkpoint_every,
             resume=not args.no_resume,
             init_params=init_params,

@@ -205,6 +205,22 @@ def parse_args(argv=None):
                         "minutes of work' on runs with variable step "
                         "times (0 = off)")
     parser.add_argument("--no_resume", action="store_true")
+    parser.add_argument("--elastic", action="store_true",
+                        help="allow a resume whose checkpoint was written "
+                        "at a DIFFERENT world size: ZeRO-1 optimizer "
+                        "shards reshard onto the live mesh, the "
+                        "error-feedback residual restarts zeroed, and the "
+                        "step counter/sampler cursor remap to the same "
+                        "data position (tpudist.resilience.elastic, "
+                        "docs/MULTIHOST.md 'Resuming on a different "
+                        "world size')")
+    parser.add_argument("--compile_cache", default=None, type=str,
+                        help="directory of serialized AOT step "
+                        "executables (tpudist.compile_cache): a "
+                        "relaunched generation deserializes its compiled "
+                        "step — overlapped with the checkpoint restore — "
+                        "instead of re-tracing; misses compile at "
+                        "bring-up and store for the next life")
     parser.add_argument("--eval", action="store_true",
                         help="run the top-1 eval pass after training — the "
                         "reference's dormant eval loop "
@@ -520,6 +536,8 @@ def main(argv=None):
         checkpoint_every=args.checkpoint_every,
         checkpoint_every_s=args.checkpoint_every_s or None,
         resume=not args.no_resume,
+        elastic=args.elastic,
+        compile_cache=args.compile_cache,
         chaos=args.chaos,
     )
 

@@ -1,0 +1,320 @@
+"""The AOT executable cache (tpudist/compile_cache.py): content-keyed
+serialize/deserialize of compiled train steps, the graceful fall-through
+contract, fit()'s warm-start wiring, and goodput's cold-vs-warm
+attribution (tpudist/resilience/goodput.py)."""
+
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from tpudist import compile_cache as cc_mod
+from tpudist import mesh as mesh_lib
+from tpudist.data.loader import DataLoader
+from tpudist.resilience import GoodputTracker
+from tpudist.telemetry import TelemetryConfig
+from tpudist.train import create_train_state, fit, make_train_step
+
+
+class _Mlp(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(10)(nn.relu(nn.Dense(37)(x)))
+
+
+def _data(rows: int = 64):
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.normal(size=(rows, 13)).astype(np.float32),
+        "label": (rng.random(rows) * 10).astype(np.int32),
+    }
+
+
+def _build(batch_rows: int = 16):
+    mesh = mesh_lib.create_mesh()
+    tx = optax.adam(1e-2)
+    state = create_train_state(_Mlp(), 0, jnp.zeros((8, 13)), tx, mesh)
+    step = make_train_step(_Mlp(), tx, mesh)
+    batch = {k: v[:batch_rows] for k, v in _data().items()}
+    return mesh, state, step, step.stage(batch)
+
+
+CONFIG = {"reduce": "none", "grad_accum": 1, "model": "_Mlp()"}
+
+
+def test_step_key_is_content_sensitive():
+    mesh, state, step, staged = _build()
+    key = cc_mod.step_key(mesh=mesh, state=state, batch=staged,
+                          config=CONFIG)
+    # deterministic
+    assert key == cc_mod.step_key(mesh=mesh, state=state, batch=staged,
+                                  config=CONFIG)
+    # any config knob, batch geometry, state geometry, or salt change
+    # must move the key — a stale executable may never be offered
+    assert key != cc_mod.step_key(mesh=mesh, state=state, batch=staged,
+                                  config=dict(CONFIG, reduce="quantized"))
+    _, _, _, staged32 = _build(batch_rows=32)
+    assert key != cc_mod.step_key(mesh=mesh, state=state, batch=staged32,
+                                  config=CONFIG)
+    assert key != cc_mod.step_key(
+        mesh=mesh, state=state.replace(step=state.step.astype(jnp.int8)),
+        batch=staged, config=CONFIG,
+    )
+    assert key != cc_mod.step_key(mesh=mesh, state=state, batch=staged,
+                                  config=CONFIG, salt="other-forward")
+
+
+def test_store_load_roundtrip_is_bit_identical(
+        tmp_path, no_persistent_compile_cache):
+    """The core contract: a deserialized executable IS the compiled step
+    — same losses, bit for bit, from identical starting states."""
+    mesh, state, step, staged = _build()
+    cache = cc_mod.CompileCache(tmp_path)
+    compiled = step.jitted.lower(state, staged).compile()
+    assert cache.store("k", compiled) > 0
+    loaded = cache.load("k")
+    assert loaded is not None
+
+    def run(fn, s, n=3):
+        out = []
+        for _ in range(n):
+            s, m = fn(s, staged)
+            out.append(float(m["loss"]))
+        return out
+
+    _, s1, _, _ = _build()
+    _, s2, _, _ = _build()
+    assert run(compiled, s1) == run(loaded, s2)
+
+
+def test_corrupt_or_alien_blob_is_a_miss(tmp_path):
+    cache = cc_mod.CompileCache(tmp_path)
+    assert cache.load("absent") is None and cache.last_load_error is None
+    cache.path_for("torn").write_bytes(b"\x00not a pickle")
+    assert cache.load("torn") is None
+    assert "Error" in (cache.last_load_error or "")
+    # schema bump: a valid pickle from a future format is also a miss
+    import pickle
+
+    cache.path_for("future").write_bytes(
+        pickle.dumps({"schema": cc_mod.SCHEMA + 1})
+    )
+    assert cache.load("future") is None
+    # and the whole begin_load/finish path reports the miss gracefully
+    mesh, state, step, staged = _build()
+    handle = cache.begin_load("torn")
+    exe, info = cache.finish(handle, step, state, staged, "torn")
+    assert exe is not None and info["hit"] is False
+    assert info["compile_s"] > 0 and info["bytes"] > 0  # compiled+stored
+
+
+def test_wrap_step_falls_back_on_first_call_mismatch(
+        tmp_path, no_persistent_compile_cache):
+    """An executable the key could not tell apart (compiled for another
+    batch shape) must fail the first-call validation BEFORE executing and
+    permanently fall through to the jit path — training continues, the
+    fallback is reported."""
+    mesh, state, step, staged16 = _build(batch_rows=16)
+    wrong = step.jitted.lower(state, step.stage(
+        {k: v[:32] for k, v in _data().items()}
+    )).compile()
+    seen = []
+    wrapped = cc_mod.wrap_step(step, wrong, on_fallback=seen.append)
+    batch = {k: v[:16] for k, v in _data().items()}
+    new_state, metrics = wrapped(state, batch)
+    assert len(seen) == 1  # validated-and-rejected exactly once
+    assert wrapped.aot["exe"] is None
+    assert np.isfinite(float(metrics["loss"]))
+    # later calls go straight to the jit path, no second report
+    new_state, _ = wrapped(new_state, batch)
+    assert len(seen) == 1
+
+
+def test_staged_example_declines_device_operands():
+    mesh, state, step, _ = _build()
+
+    class DeviceLoader(DataLoader):
+        def probe(self):
+            return {"_cache": jnp.zeros((4,)), "image": np.zeros((1, 13))}
+
+    assert cc_mod.staged_example(step, DeviceLoader(_data(), 16)) is None
+    # a plain loader stages fine
+    ex = cc_mod.staged_example(step, DataLoader(_data(), 16))
+    assert ex is not None and ex["image"].shape == (16, 13)
+
+
+def test_staged_example_never_consumes_a_single_shot_iterator():
+    """A probe()-less foreign loader whose __iter__ returns itself is a
+    single-shot stream: pulling a sample would silently eat the first
+    training batch — the cache must decline instead."""
+    mesh, state, step, _ = _build()
+
+    class OneShot:
+        batch_size = 16
+
+        def __init__(self):
+            self._batches = iter([
+                {k: v[:16] for k, v in _data().items()} for _ in range(2)
+            ])
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return next(self._batches)
+
+    loader = OneShot()
+    assert cc_mod.staged_example(step, loader) is None
+    # both batches are still there for the training loop
+    assert sum(1 for _ in loader) == 2
+
+
+def _fit(tmp_path, job_id, **kw):
+    cfg = TelemetryConfig(sentry=False, mfu=False)
+    return fit(
+        _Mlp(), optax.adam(1e-2), DataLoader(_data(), 16), epochs=2,
+        job_id=job_id, batch_size=16, log_dir=str(tmp_path),
+        telemetry=cfg, profile=False,
+        compile_cache=str(tmp_path / "cc"), **kw,
+    )
+
+
+class _CompileLog(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+def test_fit_cold_then_warm_skips_the_trace(
+        tmp_path, no_persistent_compile_cache):
+    """The acceptance pin, in-process: run 1 misses (AOT-compiles at
+    bring-up, stores, goodput books compile_s there), run 2 hits — the
+    train step is never traced or XLA-compiled again (jax's compile log
+    shows no step_fn entry), goodput books cache_load_s with compile_s=0
+    and warm_start=True, and the trajectories are bit-identical."""
+    _, l1 = _fit(tmp_path, "Cold")
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "Cold_telemetry_0.jsonl").read_text().splitlines()
+    ]
+    (cc_row,) = [r for r in rows if r["kind"] == "compile_cache"]
+    assert cc_row["hit"] is False and cc_row["bytes"] > 0
+    assert cc_row["compile_s"] > 0
+    rep = json.loads((tmp_path / "Cold_report.json").read_text())
+    good = rep["goodput"]
+    assert good["compile_s"] > 0 and good["cache_load_s"] == 0
+    assert good["warm_start"] is False
+
+    handler = _CompileLog()
+    logging.getLogger("jax").addHandler(handler)
+    jax.config.update("jax_log_compiles", True)
+    try:
+        _, l2 = _fit(tmp_path, "Warm")
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        logging.getLogger("jax").removeHandler(handler)
+    compiled_fns = [m for m in handler.messages if "step_fn" in m]
+    assert compiled_fns == []  # the trace/compile was skipped entirely
+    assert l2 == l1  # same executable → bit-identical trajectory
+
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "Warm_telemetry_0.jsonl").read_text().splitlines()
+    ]
+    (cc_row,) = [r for r in rows if r["kind"] == "compile_cache"]
+    assert cc_row["hit"] is True and cc_row["load_s"] > 0
+    assert cc_row["compile_s"] == 0
+    rep = json.loads((tmp_path / "Warm_report.json").read_text())
+    good = rep["goodput"]
+    # the satellite's honesty contract: iteration 1 on a cache hit is
+    # NOT a compile — compile_s ≈ 0 and the load time has its own bucket
+    assert good["compile_s"] == 0
+    # goodput books only the non-overlapped join wait (disjoint
+    # partition); the row carries the full thread duration separately
+    assert good["cache_load_s"] == pytest.approx(cc_row["load_wait_s"])
+    assert cc_row["load_s"] >= cc_row["load_wait_s"]
+    assert good["warm_start"] is True
+    parts = (good["bringup_s"] + good["restore_s"] + good["compile_s"]
+             + good["cache_load_s"] + good["data_wait_s"]
+             + good["checkpoint_s"] + good["productive_step_s"])
+    assert parts == pytest.approx(good["total_s"], rel=0.01)
+
+
+def test_goodput_cold_vs_warm_attribution():
+    """Pure-clock pin of the partition semantics (the satellite's unit
+    test): cold books the first iteration as compile_s; AOT-cold books
+    the bring-up compile and keeps iteration 1 ordinary; warm books
+    cache_load_s and keeps iteration 1 ordinary."""
+    t = {"now": 0.0}
+    clock = lambda: t["now"]
+
+    def run(prep):
+        gp = GoodputTracker(clock=clock, wall=clock)
+        prep(gp)
+        t["now"] += 1.0  # bring-up tail
+        gp.loop_started()
+        t["now"] += 5.0  # first iteration
+        gp.step_boundary(data_wait_s=0.5)
+        t["now"] += 1.0
+        gp.step_boundary(data_wait_s=0.25)
+        return gp.summary()
+
+    t["now"] = 0.0
+    cold = run(lambda gp: None)
+    assert cold["compile_s"] == 5.0 and cold["cache_load_s"] == 0.0
+    assert cold["data_wait_s"] == 0.25  # iteration 1's wait is in compile_s
+    assert cold["warm_start"] is False
+
+    t["now"] = 0.0
+
+    def aot_cold(gp):
+        t["now"] += 3.0
+        gp.add("compile_s", 3.0)
+        gp.set_precompiled(warm=False)
+
+    cold_aot = run(aot_cold)
+    assert cold_aot["compile_s"] == 3.0  # bring-up compile, nothing more
+    assert cold_aot["data_wait_s"] == 0.75  # iteration 1 is ordinary
+    assert cold_aot["warm_start"] is False
+    assert cold_aot["bringup_s"] == pytest.approx(1.0)
+
+    t["now"] = 0.0
+
+    def warm(gp):
+        t["now"] += 2.0
+        gp.add("cache_load_s", 2.0)
+        gp.set_precompiled(warm=True)
+
+    hot = run(warm)
+    assert hot["compile_s"] == 0.0 and hot["cache_load_s"] == 2.0
+    assert hot["data_wait_s"] == 0.75
+    assert hot["warm_start"] is True
+    assert hot["bringup_s"] == pytest.approx(1.0)
+    # the partition stays exact in every mode
+    for g in (cold, cold_aot, hot):
+        parts = (g["bringup_s"] + g["restore_s"] + g["compile_s"]
+                 + g["cache_load_s"] + g["data_wait_s"] + g["checkpoint_s"]
+                 + g["productive_step_s"])
+        assert parts == pytest.approx(g["total_s"])
+    # and a resumed warm generation's load time is restart overhead
+    t["now"] = 100.0
+    gp2 = GoodputTracker(generation=1, clock=clock, wall=clock)
+    gp2._prior = [
+        {k: v for k, v in hot.items()
+         if k not in ("generations", "cumulative", "productive_frac")}
+    ]
+    gp2.add("cache_load_s", 2.0)
+    gp2.set_precompiled(warm=True)
+    gp2.loop_started()
+    t["now"] += 1.0
+    gp2.step_boundary()
+    cum = gp2.summary()["cumulative"]
+    assert cum["restart_overhead_s"] >= 2.0

@@ -91,6 +91,11 @@ _WORLD = "tpudist" + ".launch"
 def test_spawns_world_and_world_offenders_rules():
     assert marker_audit.spawns_world(f'cmd = [sys.executable, "-m", "{_WORLD}"]')
     assert marker_audit.spawns_world("argv += ['--emulate" + "-devices=4']")
+    # the elastic drills spawn child interpreters that build their own
+    # emulated device world via the raw XLA flag, bypassing the launcher
+    assert marker_audit.spawns_world(
+        "env['XLA_FLAGS'] = '--xla_force_host_platform" + "_device_count=4'"
+    )
     assert not marker_audit.spawns_world("import subprocess\nrun(['ls'])")
     records = [
         ("tests/w.py::test_world_unmarked", True, False),

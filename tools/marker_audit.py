@@ -66,11 +66,18 @@ def offenders(records, budget: float) -> list[tuple[str, float]]:
 
 
 # source substrings that mean "this module launches a subprocess world":
-# the launcher module itself (python -m tpudist.launch) or the emulated
-# per-process device split only the launcher consumes. Checked against the
+# the launcher module itself (python -m tpudist.launch), the emulated
+# per-process device split only the launcher consumes, or a direct
+# child-interpreter spawn that builds its own emulated device world via
+# the raw XLA flag (the elastic drills relaunch children at a DIFFERENT
+# device count this way, bypassing the launcher). Checked against the
 # test FILE's source — a world is spawned from module-level harness
 # strings as often as from the test body.
-WORLD_PATTERNS = ("tpudist.launch", "--emulate-devices")
+WORLD_PATTERNS = (
+    "tpudist.launch",
+    "--emulate-devices",
+    "xla_force_host_platform_device_count",
+)
 
 
 def spawns_world(source: str) -> bool:

@@ -18,12 +18,30 @@ sharding tree.
 from __future__ import annotations
 
 import dataclasses
+import os
+import tempfile
 from pathlib import Path
 
 import jax
 import orbax.checkpoint as ocp
 
 from tpudist.train import TrainState
+
+#: subdirectory old-geometry step dirs are moved under while an elastic
+#: reshard commits (``quarantine_steps``): the per-step renames are atomic
+#: and reversible, so a crash mid-commit can always roll back to a state
+#: that restores — either the fresh new-world step (if its save landed)
+#: or the quarantined old-world steps (``recover_interrupted_reshard``).
+#: A digit-free name: orbax's step scan parses any trailing integer, so a
+#: sibling like ``stale_4`` would read as step 4 and crash the manager.
+QUARANTINE_DIR = "_pre_reshard"
+
+#: where restore's fallback walk sets aside step dirs that failed to
+#: deserialize — moved, never deleted (the failure may be transient I/O
+#: and the dir may still hold the healthy newest state), but out of the
+#: step namespace so latest_step and orbax's monotonic save order stop
+#: seeing them. A digit-free name, same rule as QUARANTINE_DIR.
+FAILED_DIR = "_failed"
 
 
 @dataclasses.dataclass
@@ -41,12 +59,20 @@ class Checkpointer:
 
     def __post_init__(self):
         self.directory = Path(self.directory).absolute()
-        self._mgr = ocp.CheckpointManager(
+        self._mgr = self._make_manager()
+
+    def _make_manager(self) -> ocp.CheckpointManager:
+        return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=self.max_to_keep,
                 enable_async_checkpointing=True,
             ),
+            # registers the standard handler at construction: a FRESH
+            # manager (a relaunched generation) can then serve
+            # item_metadata() — the elastic reshard's shape source —
+            # before any save/restore call has lazily registered it
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     # -- write ------------------------------------------------------------
@@ -73,27 +99,314 @@ class Checkpointer:
         """Block until in-flight async saves are durable."""
         self._mgr.wait_until_finished()
 
+    def quarantine_failed_step(self, step: int) -> bool:
+        """Set aside one saved step that failed to deserialize (the
+        corrupt-fallback cleanup): the dir moves into ``_failed/`` so it
+        stops blocking orbax's monotonic save order and shadowing
+        latest_step for the next resume — but is NEVER deleted, because
+        the failure may have been transient I/O (an NFS/GCS hiccup) and
+        the "torn" checkpoint may in fact be the healthy newest state an
+        operator can still recover by moving it back.
+
+        Multi-process discipline (same shape as
+        ``recover_interrupted_reshard``): the early return reads
+        PRE-mutation state — stable because rank 0's surgery sits BEHIND
+        the entry barrier, which it cannot pass until every rank has
+        taken the same branch — so every rank runs the same collective
+        sequence; the rank-0 filesystem surgery alone is fail-soft (a
+        cleanup must never kill a resume that already succeeded), never
+        the barrier."""
+        step = int(step)
+        src = self.directory / str(step)
+        if not src.is_dir():
+            return False
+        self._sync("failed-step-enter")
+        if jax.process_index() == 0:
+            try:
+                import shutil
+
+                d = self.directory / FAILED_DIR
+                d.mkdir(exist_ok=True)
+                target = d / str(step)
+                if target.exists():
+                    shutil.rmtree(target, ignore_errors=True)
+                os.replace(src, target)
+            except OSError:
+                pass
+        self._sync("failed-step")
+        self._reopen()
+        return (self.directory / FAILED_DIR / str(step)).is_dir()
+
     # -- read -------------------------------------------------------------
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, like: TrainState, step: int | None = None) -> TrainState:
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def saved_metadata(self, step: int):
+        """The SAVED tree's per-leaf metadata (shapes/dtypes/old shardings)
+        as orbax recorded it — what the elastic reshard aligns the live
+        state against (``tpudist.resilience.elastic``)."""
+        return self._mgr.item_metadata(step)
+
+    def raw_restore(self, step: int, abstract):
+        """Restore ``step`` onto an explicit abstract tree — the reshard
+        path's escape hatch, where the abstract shapes are the checkpoint's
+        own (old-world) shapes rather than the live state's."""
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def restore(
+        self,
+        like: TrainState,
+        step: int | None = None,
+        *,
+        reshard: bool = False,
+        run_meta: dict | None = None,
+        mesh=None,
+        fallback: bool = False,
+        on_event=None,
+    ) -> TrainState:
         """Restore a checkpoint onto the placement of ``like``.
 
         ``like`` supplies the tree structure, dtypes, and shardings (it can
         be a freshly-initialized state); leaves are created directly on the
         devices that own them — no host-side gather.
+
+        ``reshard=True`` is the elastic-restart mode (``fit(elastic=True)``,
+        docs/MULTIHOST.md "Resuming on a different world size"): when the
+        saved ``tpudist_meta.json`` geometry disagrees with ``run_meta``,
+        the mismatch is validated as a pure world resize and the
+        world-bound leaves (ZeRO-1 pad-and-reshape optimizer shards) are
+        re-laid onto the live ``mesh``; the error-feedback residual
+        restarts zeroed and ``state.step`` comes back remapped into the
+        new world's step units (:mod:`tpudist.resilience.elastic`). Any
+        mismatch that is NOT a world resize still refuses loudly.
+
+        ``fallback=True`` walks back to the previous saved step when the
+        newest fails to deserialize (a preemption landing mid-save can
+        leave a truncated step dir) — each failed step emits a
+        ``checkpoint_fallback`` event through ``on_event`` and the walk
+        continues oldest-ward; only when every step fails does the last
+        error propagate.
         """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-            if isinstance(x, jax.Array) else x,
-            like,
+        saved_meta = self.read_meta() if reshard else None
+        if reshard and run_meta is not None and saved_meta is not None:
+            from tpudist.resilience import elastic
+
+            do_reshard = not elastic.meta_matches(saved_meta, run_meta)
+        else:
+            do_reshard = False
+        if step is not None:
+            steps = [int(step)]
+        else:
+            steps = sorted(self.all_steps(), reverse=True)
+            if not steps:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+            if not fallback:
+                steps = steps[:1]
+        if do_reshard:
+            # validate BEFORE any restore attempt: a refused geometry must
+            # raise its own error, never be mistaken for corruption
+            from tpudist.resilience import elastic
+
+            reason = elastic.refusal_reason(saved_meta, run_meta)
+            if reason is not None:
+                raise elastic.ElasticRefusal(
+                    f"checkpoint at {self.directory} cannot be elastically "
+                    f"resumed: {reason} — resume with the original settings "
+                    "or start a fresh checkpoint_dir"
+                )
+        last_exc: Exception | None = None
+        for i, s in enumerate(steps):
+            try:
+                if do_reshard:
+                    from tpudist.resilience import elastic
+
+                    state = elastic.reshard_restore(
+                        self, like, s, mesh=mesh, saved_meta=saved_meta,
+                        run_meta=run_meta, on_event=on_event,
+                    )
+                else:
+                    abstract = jax.tree_util.tree_map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            x.shape, x.dtype, sharding=x.sharding
+                        )
+                        if isinstance(x, jax.Array) else x,
+                        like,
+                    )
+                    state = self._mgr.restore(
+                        s, args=ocp.args.StandardRestore(abstract)
+                    )
+                return state
+            except Exception as exc:  # truncated/partial step dir
+                from tpudist.resilience.elastic import ElasticRefusal
+
+                if isinstance(exc, ElasticRefusal):
+                    # geometry/structure refusals are decisions, not damage
+                    # — an older checkpoint would refuse identically
+                    raise
+                last_exc = exc
+                if on_event is not None and len(steps) > 1:
+                    on_event({
+                        "tag": "checkpoint_fallback",
+                        "failed_step": int(s),
+                        "error": f"{type(exc).__name__}: {exc}"[:400],
+                        "next_step": (
+                            int(steps[i + 1]) if i + 1 < len(steps) else None
+                        ),
+                    })
+        raise last_exc
+
+    # -- elastic reshard commit -------------------------------------------
+    # An elastic resume rewrites history: the restored state's step counter
+    # is in NEW-world units, so the old-geometry step dirs become
+    # uninterpretable (and orbax refuses out-of-order saves anyway when the
+    # remapped counter shrank). The commit protocol keeps a restorable —
+    # and correctly DESCRIBED — checkpoint on disk at every instant:
+    #   1. quarantine_steps(commit_meta=new): atomically rename every old
+    #      step dir into QUARANTINE_DIR (still a valid old-world
+    #      checkpoint), drop the commit marker (the NEW meta, written
+    #      atomically inside the quarantine dir) and reopen the manager
+    #      on the now-empty step namespace;
+    #   2. save(state, wait=True) at the remapped step (durable);
+    #   3. write_meta(new) — the atomic flip;
+    #   4. purge_quarantined() — garbage (marker included) only now.
+    # recover_interrupted_reshard() makes every crash window safe:
+    #   - any live step + the marker ⇒ the save landed but the flip may
+    #     not have: ADOPT the marker as the meta and purge (idempotent
+    #     past step 3 — without this, a crash between 2 and 3 would make
+    #     the next bring-up re-reshard the already-new-world checkpoint:
+    #     a double-remapped cursor, and a quarantine rename onto the
+    #     occupied source step number);
+    #   - no marker ⇒ the renames may be partial: roll every quarantined
+    #     dir back (the old meta still describes them);
+    #   - marker but no live step ⇒ the save never landed: roll back and
+    #     drop the marker.
+
+    COMMIT_MARKER = "commit_meta.json"
+
+    def _reopen(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+        self._mgr = self._make_manager()
+
+    @staticmethod
+    def _sync(tag: str) -> None:
+        # multi-process fence around rank-0 directory surgery: every
+        # process must see the renames complete before rebuilding its
+        # manager (whose constructor scans the step namespace) or calling
+        # the next coordinated save. No-op single-process.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"tpudist-ckpt-{tag}")
+
+    def _quarantined(self) -> list[Path]:
+        q = self.directory / QUARANTINE_DIR
+        if not q.is_dir():
+            return []
+        return sorted(
+            (p for p in q.iterdir() if p.is_dir() and p.name.isdigit()),
+            key=lambda p: int(p.name),
         )
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def quarantine_steps(self, commit_meta: dict | None = None) -> list[int]:
+        """Move every live step dir aside (atomic renames), drop the
+        commit marker describing the NEW geometry, and reopen the manager
+        on the emptied namespace. Returns the quarantined step numbers."""
+        import json
+
+        self._mgr.wait_until_finished()
+        steps = self.all_steps()
+        if jax.process_index() == 0:
+            q = self.directory / QUARANTINE_DIR
+            q.mkdir(exist_ok=True)
+            for s in steps:
+                src = self.directory / str(s)
+                if src.is_dir():
+                    os.replace(src, q / str(s))
+            if commit_meta is not None:
+                # written only AFTER every rename: its presence certifies
+                # the quarantine completed, so recovery can tell a
+                # mid-commit crash from a mid-quarantine one
+                fd, tmp = tempfile.mkstemp(dir=q, suffix=".tmp")
+                with os.fdopen(fd, "w") as f:
+                    f.write(json.dumps(commit_meta))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, q / self.COMMIT_MARKER)
+        self._sync("quarantine")
+        self._reopen()
+        return steps
+
+    def recover_interrupted_reshard(self) -> str | None:
+        """Finish or roll back a reshard commit a crash interrupted (see
+        the protocol above). Returns ``"completed"`` (a saved new-world
+        step existed: its marker meta was adopted and the quarantine
+        purged), ``"rolled_back"`` (quarantined dirs renamed back under
+        the still-valid old meta), or ``None`` (no interrupted commit)."""
+        import json
+
+        # the decision and the marker's content are read from
+        # PRE-mutation state, which is stable: every rank calls this at
+        # the same bring-up point, and no rank mutates anything until
+        # the entry barrier below has collected them all — so every rank
+        # takes the same branch and runs the same collective sequence
+        # (the TOCTOU alternative — rank 0 finishing its surgery before
+        # a slower rank's existence check — would strand that rank
+        # outside the barrier and hang the relaunch).
+        q_dir = self.directory / QUARANTINE_DIR
+        if not q_dir.is_dir():
+            return None
+        marker = q_dir / self.COMMIT_MARKER
+        adopt_meta = None
+        if self.all_steps() and marker.exists():
+            adopt_meta = json.loads(marker.read_text())
+        self._sync("recover-enter")
+        if adopt_meta is not None:
+            # the barrier-save landed: the live steps are NEW-world and
+            # the marker is their authoritative description — flip the
+            # meta (idempotent if the crash came after the flip) and purge
+            self.write_meta(adopt_meta)
+            self._sync("adopt-commit")
+            self.purge_quarantined()
+            self._sync("adopt-purge")
+            return "completed"
+        if jax.process_index() == 0:
+            # marker FIRST: a rollback that crashes mid-way must leave a
+            # marker-less quarantine (retried as another rollback), never
+            # marker + rolled-back old steps (which the next bring-up
+            # would mis-read as a committed save and stamp with NEW meta)
+            if marker.exists():
+                os.unlink(marker)
+            for p in self._quarantined():
+                os.replace(p, self.directory / p.name)
+            try:
+                q_dir.rmdir()
+            except OSError:
+                pass
+        self._sync("unquarantine")
+        self._reopen()
+        return "rolled_back"
+
+    def purge_quarantined(self) -> None:
+        """Delete quarantined old-geometry dirs — only called once a
+        new-world step AND its meta are durable (they are garbage from
+        then on). Step dirs go first and the commit marker LAST: a crash
+        mid-purge must leave either marker+dirs (re-adopt, idempotent) or
+        marker-with-no-dirs — never orphaned old-world dirs without the
+        marker, which the recovery path would roll back into a live
+        directory already described by the NEW meta."""
+        import shutil
+
+        if jax.process_index() == 0:
+            q = self.directory / QUARANTINE_DIR
+            for p in self._quarantined():
+                shutil.rmtree(p, ignore_errors=True)
+            shutil.rmtree(q, ignore_errors=True)
 
     # -- run metadata -----------------------------------------------------
     # guards resume against a changed run geometry (batch size / world size
@@ -102,7 +415,25 @@ class Checkpointer:
         import json
 
         if jax.process_index() == 0:
-            (self.directory / "tpudist_meta.json").write_text(json.dumps(meta))
+            # atomic: a preemption landing mid-write must never leave a
+            # torn half-JSON that poisons the next generation's resume
+            # validation — write a sibling tmp file and os.replace it in
+            target = self.directory / "tpudist_meta.json"
+            fd, tmp = tempfile.mkstemp(
+                dir=self.directory, prefix=".tpudist_meta.", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(json.dumps(meta))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, target)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
     def read_meta(self) -> dict | None:
         import json

@@ -56,10 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument(
-        "--emulate-devices", type=int, default=0,
+        "--emulate-devices", type=str, default="0",
         help="give each spawned process this many fake CPU devices "
         "(sets JAX_PLATFORMS=cpu + xla_force_host_platform_device_count); "
-        "for TPU-less testing of the multi-process path",
+        "for TPU-less testing of the multi-process path. A comma list "
+        "gives one value PER RESTART GENERATION ('8,4': the first world "
+        "gets 8 devices, every relaunch gets 4) — the emulated form of "
+        "an elastic resize, where the relaunched world comes up on "
+        "whatever hardware is left and the trainer reshards via "
+        "fit(elastic=True) (docs/MULTIHOST.md)",
     )
     p.add_argument("--no_python", action="store_true",
                    help="run the script as an executable instead of `python script`")
@@ -107,6 +112,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
+
+
+def _emulated_devices(args, generation: int) -> int:
+    """The fake-CPU device count generation ``generation`` gets: the
+    launcher re-probes the device world at every relaunch — on real
+    hardware the relaunched process re-enumerates its own attach, and
+    under emulation the per-generation ``--emulate-devices`` list plays
+    the part of hardware that shrank (or returned)."""
+    values = [int(v) for v in str(args.emulate_devices).split(",") if v != ""]
+    if not values:
+        return 0
+    return values[min(generation, len(values) - 1)]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -201,12 +218,16 @@ def _run_world(args, stop: dict | None = None, generation: int = 0) -> int:
         # which life of the job this is: telemetry stamps heartbeats and
         # the run report with it, goodput aggregates across it
         env[GENERATION_ENV] = str(generation)
-        if args.emulate_devices:
+        emulate = _emulated_devices(args, generation)
+        if emulate:
             env["JAX_PLATFORMS"] = "cpu"
             env["TPUDIST_FORCE_CPU"] = "1"
+            # the re-probed world, exported so tooling can tell what this
+            # generation was granted without parsing XLA flags
+            env["TPUDIST_WORLD_DEVICES"] = str(emulate)
             flags = env.get("XLA_FLAGS", "")
             env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={args.emulate_devices}"
+                f"{flags} --xla_force_host_platform_device_count={emulate}"
             ).strip()
         cmd = [] if args.no_python else [sys.executable, "-u"]
         cmd = cmd + [args.script, f"--local_rank={local_rank}"] + args.script_args

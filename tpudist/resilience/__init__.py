@@ -18,9 +18,16 @@ the whole run:
 - :mod:`~tpudist.resilience.goodput` — wall-time partitioning (productive
   step time vs compile/checkpoint/data-wait/restart overhead), aggregated
   across generations into the run report's ``goodput`` section;
-- :mod:`~tpudist.resilience.chaos` — deterministic crash/hang/SIGTERM
-  injection (``main.py --chaos``, the recovery tests, the bench's
-  ``gpt2_124m_preempt_recovery_s`` leg).
+- :mod:`~tpudist.resilience.chaos` — deterministic crash/hang/SIGTERM/
+  checkpoint-corruption injection (``main.py --chaos``, the recovery
+  tests, the bench's ``gpt2_124m_preempt_recovery_s`` leg);
+- :mod:`~tpudist.resilience.elastic` — cross-world-size checkpoint
+  resharding (``fit(elastic=True)``): ZeRO-1 pad-and-reshape layouts
+  re-laid onto the surviving mesh, error-feedback residual flushed,
+  sampler cursor remapped — a preempted world resumes on whatever
+  hardware is left (docs/MULTIHOST.md "Resuming on a different world
+  size"). The AOT executable cache that makes the relaunch cheap lives
+  in :mod:`tpudist.compile_cache`.
 
 Operational recipe: docs/MULTIHOST.md "Surviving preemption".
 """
@@ -29,7 +36,14 @@ from tpudist.resilience.chaos import (
     ChaosCrash,
     ChaosInjector,
     ChaosSpec,
+    corrupt_latest_checkpoint,
     make_injector,
+)
+from tpudist.resilience.elastic import (
+    ElasticRefusal,
+    elastic_mismatch,
+    remap_step,
+    reshard_restore,
 )
 from tpudist.resilience.exitcodes import (
     EXIT_CRASH,
@@ -72,4 +86,9 @@ __all__ = [
     "ChaosSpec",
     "ChaosInjector",
     "make_injector",
+    "corrupt_latest_checkpoint",
+    "ElasticRefusal",
+    "elastic_mismatch",
+    "remap_step",
+    "reshard_restore",
 ]

@@ -13,6 +13,10 @@ Spec grammar (``ChaosSpec.parse``)::
     crash@12        raise ChaosCrash after step 12 completes (gen 0 only)
     sigterm@12      SIGTERM self after step 12 (the preemption drill)
     hang:600@12     block the loop 600 s after step 12 (watchdog food)
+    corrupt@12      truncate the NEWEST checkpoint's files after step 12,
+                    then crash — the die-mid-write drill that the
+                    corrupt-checkpoint fallback (``Checkpointer.restore``
+                    walking back to the previous step) must absorb
     crash@5@*       crash at step 5 in EVERY generation — the
                     deterministic-crash loop that must exhaust the
                     supervisor's restart budget, not spin
@@ -36,9 +40,10 @@ import time
 
 from tpudist.resilience.exitcodes import restart_generation
 
-__all__ = ["ChaosCrash", "ChaosSpec", "ChaosInjector", "make_injector"]
+__all__ = ["ChaosCrash", "ChaosSpec", "ChaosInjector", "make_injector",
+           "corrupt_latest_checkpoint"]
 
-KINDS = ("crash", "hang", "sigterm")
+KINDS = ("crash", "hang", "sigterm", "corrupt")
 DEFAULT_HANG_S = 3600.0
 
 
@@ -93,6 +98,20 @@ class ChaosInjector:
         self.fired = False
         self._sleep = sleep
         self._kill = kill
+        # the corrupt drill's target; fit() binds its checkpoint_dir
+        self.checkpoint_dir = None
+        self._wait = None
+
+    def bind(self, checkpoint_dir, wait=None) -> "ChaosInjector":
+        """Attach the run's checkpoint dir (the ``corrupt`` kind's
+        target) and optionally the checkpointer's ``wait`` (so the drill
+        corrupts a DETERMINISTIC step: the newest save is made durable
+        before the truncation, instead of racing the async commit);
+        chained so ``make_injector(...).bind(dir)`` reads naturally.
+        No-op for the other kinds."""
+        self.checkpoint_dir = checkpoint_dir
+        self._wait = wait
+        return self
 
     def maybe_fire(self, completed_step: int) -> bool:
         """Fire once when ``completed_step`` reaches the spec's step in an
@@ -112,11 +131,52 @@ class ChaosInjector:
         if self.spec.kind == "hang":
             self._sleep(self.spec.duration_s)
             return True
+        if self.spec.kind == "corrupt":
+            if self._wait is not None:
+                self._wait()  # settle async saves: corrupt a committed step
+            corrupt_latest_checkpoint(self.checkpoint_dir)
+            # then die the way a real mid-write preemption does: a hard
+            # crash, so the supervisor's relaunch exercises the fallback
+            # walk end to end
+            raise ChaosCrash(
+                f"chaos: corrupted newest checkpoint after step "
+                f"{completed_step} (generation {self.generation})"
+            )
         # sigterm: the preemption drill — the signal lands on this very
         # process; with fit()'s PreemptionGuard installed the flag is set
         # before the next step dispatches
         self._kill(os.getpid(), signal.SIGTERM)
         return True
+
+
+def corrupt_latest_checkpoint(checkpoint_dir) -> int:
+    """Truncate every file of the NEWEST step dir under
+    ``checkpoint_dir`` to half its size — the torn state a preemption
+    landing mid-checkpoint-write leaves behind. The dir itself survives
+    (so ``latest_step`` still points at it: exactly the poisoned-resume
+    scenario the fallback walk exists for). Returns the corrupted step."""
+    from pathlib import Path
+
+    from tpudist.checkpoint import latest_step
+
+    if checkpoint_dir is None:
+        raise ChaosCrash(
+            "chaos: corrupt@step needs a checkpoint_dir (fit binds it; "
+            "standalone injectors use .bind(dir))"
+        )
+    step = latest_step(checkpoint_dir)
+    if step is None:
+        raise ChaosCrash(
+            f"chaos: corrupt@step found no checkpoint under "
+            f"{checkpoint_dir} to corrupt — schedule it after the first "
+            "save (checkpoint_every)"
+        )
+    step_dir = Path(checkpoint_dir) / str(step)
+    for f in sorted(p for p in step_dir.rglob("*") if p.is_file()):
+        size = f.stat().st_size
+        with open(f, "r+b") as fh:
+            fh.truncate(size // 2)
+    return step
 
 
 def make_injector(chaos) -> ChaosInjector | None:

@@ -14,7 +14,19 @@ through the ``{job}_report.json`` each generation leaves behind:
 - ``restore_s`` — checkpoint restore (the resume read);
 - ``compile_s`` — the first loop iteration wall time (jit traces and
   compiles synchronously on first call, so iteration 1 *is* the compile,
-  plus one ordinary step — an upper bound, noted not subtracted);
+  plus one ordinary step — an upper bound, noted not subtracted). With
+  the AOT path (``fit(compile_cache=...)``) compilation happens at
+  bring-up instead and is added explicitly; :meth:`GoodputTracker
+  .set_precompiled` then keeps iteration 1 an ordinary step;
+- ``cache_load_s`` — seconds bring-up BLOCKED on the AOT executable
+  deserialization (``tpudist.compile_cache``): a warm start's analogue
+  of compile time. The load runs on a side thread overlapped with the
+  restore, so only the non-hidden join wait is booked — the partition
+  stays disjoint (the full thread duration rides the telemetry
+  ``compile_cache`` row as ``load_s``). Kept as its own component so a
+  cache-hit first iteration is never mislabeled ``compile_s`` —
+  ``restart_overhead_s`` still counts it (it is restart cost), but the
+  cold-vs-warm A/B stays readable;
 - ``data_wait_s`` — seconds the loop blocked on the batch iterator
   (steady-state iterations only; iteration 1's wait is inside
   ``compile_s``);
@@ -52,6 +64,7 @@ COMPONENTS = (
     "bringup_s",
     "restore_s",
     "compile_s",
+    "cache_load_s",
     "data_wait_s",
     "checkpoint_s",
 )
@@ -71,6 +84,8 @@ class GoodputTracker:
         self.steps = 0
         self._loop_t: float | None = None
         self._first_step_done = False
+        self._precompiled = False
+        self._warm = False
         self._prior: list[dict] = []
 
     # -- wiring ------------------------------------------------------------
@@ -97,28 +112,53 @@ class GoodputTracker:
         self.add("checkpoint_s", seconds)
         self.emergency_save_s += max(float(seconds), 0.0)
 
+    def set_precompiled(self, warm: bool = False) -> None:
+        """The step executable exists BEFORE the loop (AOT path:
+        ``tpudist.compile_cache`` compiled it at bring-up on a miss, or
+        deserialized it on a hit): iteration 1 is an ordinary step and
+        must not be attributed to ``compile_s``. ``warm`` marks a cache
+        hit — the entry's ``warm_start`` field, what the bench's
+        cold-vs-warm A/B keys on."""
+        self._precompiled = True
+        self._warm = bool(warm)
+
+    def clear_precompiled(self) -> None:
+        """The precompiled executable was REJECTED at first call (the
+        AOT wrapper fell back to tracing): iteration 1 will pay a real
+        trace+compile after all, so the attribution reverts to the cold
+        contract — and the generation stops claiming a warm start (the
+        cache load it did pay stays booked in ``cache_load_s``)."""
+        self._precompiled = False
+        self._warm = False
+
     def loop_started(self) -> None:
         """The epoch loop is about to run: everything so far that isn't
-        already attributed (restore, early checkpoint work) is bring-up."""
+        already attributed (restore, compile/cache work on the AOT path,
+        early checkpoint work) is bring-up."""
         self._loop_t = self._clock()
         self._parts["bringup_s"] = max(
             (self._loop_t - self._t0)
-            - self._parts["restore_s"] - self._parts["checkpoint_s"],
+            - self._parts["restore_s"] - self._parts["checkpoint_s"]
+            - self._parts["compile_s"] - self._parts["cache_load_s"],
             0.0,
         )
 
     def step_boundary(self, data_wait_s: float = 0.0) -> None:
         """Called once per completed loop iteration. The first iteration
         is attributed whole to ``compile_s`` (jit compiles synchronously
-        inside it); later iterations contribute their measured data
+        inside it) — UNLESS the executable was precompiled/cache-loaded
+        at bring-up (:meth:`set_precompiled`), in which case iteration 1
+        is an ordinary step and contributes its measured data wait like
+        any other; later iterations contribute their measured data
         wait."""
         self.steps += 1
         now = self._clock()
         if not self._first_step_done:
             self._first_step_done = True
-            base = self._loop_t if self._loop_t is not None else self._t0
-            self._parts["compile_s"] = max(now - base, 0.0)
-            return
+            if not self._precompiled:
+                base = self._loop_t if self._loop_t is not None else self._t0
+                self._parts["compile_s"] = max(now - base, 0.0)
+                return
         self.add("data_wait_s", data_wait_s)
 
     # -- report ------------------------------------------------------------
@@ -133,6 +173,7 @@ class GoodputTracker:
             "productive_step_s": round(max(total - overhead, 0.0), 6),
             **{k: round(v, 6) for k, v in self._parts.items()},
             "emergency_save_s": round(self.emergency_save_s, 6),
+            "warm_start": bool(self._warm),
             "steps": self.steps,
             "start_wall": round(self.start_wall, 3),
             "end_wall": round(self._wall(), 3),
@@ -153,7 +194,8 @@ class GoodputTracker:
         restart_overhead = (
             sum(gaps)
             + sum(g.get("bringup_s", 0.0) + g.get("restore_s", 0.0)
-                  + g.get("compile_s", 0.0) for g in resumed)
+                  + g.get("compile_s", 0.0) + g.get("cache_load_s", 0.0)
+                  for g in resumed)
             + sum(g.get("emergency_save_s", 0.0) for g in gens)
         )
         total = sum(g.get("total_s", 0.0) for g in gens) + sum(gaps)

@@ -170,7 +170,11 @@ class TelemetrySink:
     gradient reduction's one-time wire accounting), ``fusion`` (one-time
     step-fusion config: which Pallas kernels — fused LN, fused optimizer
     — the compiled step engaged, and the compute-copy dtype), ``warning``
-    (tagged one-shot diagnoses, e.g. ``h2d_link_bound``). The serving engine
+    (tagged one-shot diagnoses, e.g. ``h2d_link_bound``,
+    ``checkpoint_fallback``), ``reshard`` (one-time elastic-resume record:
+    cross-world-size ZeRO-1 relayout, residual flush, cursor remap),
+    ``compile_cache`` (one-time AOT executable-cache outcome:
+    hit/miss/bytes/load_s). The serving engine
     (``tpudist.serve``) writes ``serve``/``serve_summary`` SLO rows
     through the same sink. Schema glossary in docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
     APPEND mode — both halves of the flight-recorder contract: the anomaly
@@ -513,6 +517,32 @@ class Telemetry:
                 probe_s=None if probe_s is None else round(probe_s, 6),
                 **self._comm,
             )
+
+    def set_reshard(self, info: Mapping[str, Any]) -> None:
+        """One-time ``reshard`` row: an elastic resume re-laid the
+        world-bound state onto a different world size
+        (``tpudist.resilience.elastic``) — old/new world, how many
+        ZeRO-1 leaves moved, whether the error-feedback residual banks
+        were flushed, and the sampler-cursor remap. Every rank writes its
+        own row (each rank restored its own shards); absent unless a
+        reshard actually happened, so streams stay byte-identical."""
+        self.sink.write("reshard", **dict(info))
+
+    def set_compile_cache(self, info: Mapping[str, Any]) -> None:
+        """One-time ``compile_cache`` row (rank 0): the AOT executable
+        cache's bring-up outcome (``tpudist.compile_cache``) — hit/miss,
+        payload bytes, measured load/compile/store seconds. Only written
+        when ``fit`` got a ``compile_cache=`` request."""
+        if self.rank == 0:
+            self.sink.write("compile_cache", **dict(info))
+
+    def warn(self, tag: str, step: int | None = None, **fields) -> None:
+        """A tagged one-shot ``warning`` row (same schema as the
+        h2d_link_bound diagnosis): the home for bring-up diagnoses other
+        subsystems hand fit() — e.g. ``checkpoint_fallback`` when the
+        newest checkpoint failed to deserialize and the restore walked
+        back a step."""
+        self.sink.write("warning", step, tag=tag, **fields)
 
     def observe_batch(self, batch: Mapping[str, Any]) -> None:
         """Size the MFU numerator from the first staged batch's GLOBAL

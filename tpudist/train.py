@@ -760,6 +760,8 @@ def fit(
     checkpoint_every: int = 0,
     checkpoint_every_s: float | None = None,
     resume: bool = True,
+    elastic: bool = False,
+    compile_cache: str | None = None,
     preempt: bool | str = "auto",
     chaos=None,
     init_params=None,
@@ -790,6 +792,36 @@ def fit(
     knob's whole point), so the worst-case save frequency is the SUM of
     the two cadences, not the denser one.
 
+    ``elastic=True`` lets a resume proceed when the checkpoint's recorded
+    geometry differs from the live run by a WORLD RESIZE only
+    (``tpudist.resilience.elastic``, docs/MULTIHOST.md "Resuming on a
+    different world size"): ZeRO-1's pad-and-reshape optimizer leaves are
+    re-laid onto the new mesh, the quantized reducer's error-feedback
+    residual restarts zeroed (one step of uncompensated quantization
+    noise, recorded by a one-shot telemetry ``reshard`` row), and
+    ``state.step`` is remapped into the new world's step units so the
+    sampler cursor lands on the same data position. The resharded state
+    is committed immediately — a synchronous save in new-step units plus
+    an atomic meta flip, with the old-geometry steps quarantined until
+    both are durable — so a crash mid-commit always leaves a restorable
+    directory. Mismatches that are NOT a pure resize (reduction method,
+    shard_opt_state) still refuse loudly. The newest-checkpoint
+    deserialization failure fallback (walk back one saved step, tagged
+    ``checkpoint_fallback`` warning row) is active on every resume,
+    elastic or not.
+
+    ``compile_cache`` names a directory of serialized AOT step
+    executables (``tpudist.compile_cache``): bring-up starts
+    deserializing the matching executable WHILE the checkpoint restore
+    streams, so a relaunched generation skips tracing entirely on a hit
+    (the dominant term in ``restart_overhead_s``); on a miss the step is
+    AOT-compiled at bring-up and stored for the next life. Keyed by
+    (device topology, state/batch geometry, step config, jax versions);
+    any mismatch or deserialization failure falls through to ordinary
+    tracing with a ``warning`` row — the cache can cost a recompile,
+    never a wrong program. Goodput attributes a warm first iteration to
+    ``cache_load_s``, not ``compile_s``.
+
     ``preempt`` (default ``"auto"``) traps SIGTERM/SIGINT as a
     signal-safe flag checked at step boundaries (``tpudist.resilience``):
     on trip the in-flight step finishes, a *synchronous* emergency
@@ -802,9 +834,11 @@ def fit(
 
     ``chaos`` injects a deterministic fault at a step boundary for
     recovery testing (``tpudist.resilience.chaos``): a spec string like
-    ``"sigterm@12"`` / ``"crash@5@*"`` / ``"hang:600@8"``, a
-    ``ChaosSpec``, or a prebuilt ``ChaosInjector``. ``None`` (default)
-    injects nothing.
+    ``"sigterm@12"`` / ``"crash@5@*"`` / ``"hang:600@8"`` /
+    ``"corrupt@12"`` (truncate the newest checkpoint, then crash — the
+    die-mid-write drill the fallback restore absorbs), a ``ChaosSpec``,
+    or a prebuilt ``ChaosInjector``. ``None`` (default) injects
+    nothing.
 
     ``telemetry`` (False | True | ``tpudist.telemetry.TelemetryConfig``)
     turns on the observability subsystem (docs/OBSERVABILITY.md): in-step
@@ -972,6 +1006,13 @@ def fit(
         # rounding stream) is world-size-bound — resuming a quantized run
         # replicated (or vice versa) must refuse, not silently diverge
         run_meta["reduce"] = step.grad_reducer.method
+    if shard_opt_state or step.grad_reducer is not None:
+        # the world the stored layouts are actually bound to is the MESH's
+        # data-axis size, not the (process-count-shaped) world_size above:
+        # a device-count resize with an unchanged process count would
+        # otherwise slip past the geometry guard and die in orbax with a
+        # bare shape mismatch instead of a validated reshard/refusal
+        run_meta["data_world"] = int(mesh.shape[mesh_lib.DATA_AXIS])
     from tpudist.resilience import (
         GoodputTracker,
         Preempted,
@@ -997,6 +1038,79 @@ def fit(
     losses: list[float] = []
     logger = None
     tel = None
+    # bring-up diagnoses that happen BEFORE the telemetry sink exists
+    # (reshard record, checkpoint-fallback warnings, compile-cache
+    # outcome) — replayed into the sink once it is up
+    bringup_events: list[dict] = []
+    # AOT executable cache (tpudist.compile_cache): start deserializing
+    # the cached step executable NOW, on a side thread, so the load
+    # overlaps the checkpoint restore below instead of serializing with it
+    cc = cc_key = cc_handle = cc_staged = None
+    cc_info: dict | None = None
+    tel_box: list = []  # late-bound telemetry ref for the AOT fallback
+    if compile_cache is not None:
+        try:
+            from tpudist import compile_cache as cc_mod
+
+            cc = cc_mod.CompileCache(compile_cache)
+            cc_staged = cc_mod.staged_example(step, train_loader)
+            if cc_staged is None:
+                bringup_events.append({
+                    "tag": "compile_cache_unsupported",
+                    "reason": "loader cannot be probed into a shaped "
+                    "batch (device-resident operands or unsized stream) "
+                    "— falling through to ordinary tracing",
+                })
+                cc = None
+            else:
+                tel_knobs = tel_cfg.step_kwargs() if tel_cfg else {}
+                model_id = cc_mod.model_identity(model)
+                if ":" not in model_id:
+                    # type-only identity (address-bearing default repr):
+                    # the key cannot see model-code edits — say so once
+                    bringup_events.append({
+                        "tag": "compile_cache_weak_key",
+                        "reason": "model repr is the default "
+                        "address-bearing one, so the cache key sees only "
+                        "the model TYPE — code edits with identical "
+                        "geometry would reuse a stale executable; bump "
+                        "the compile_cache dir after changing model code",
+                    })
+                cc_key = cc_mod.step_key(
+                    mesh=mesh, state=state, batch=cc_staged,
+                    config={
+                        "reduce": getattr(
+                            step.grad_reducer, "method", "none"
+                        ),
+                        "fused": sorted(step.fused),
+                        "grad_accum": grad_accum,
+                        "remat": str(remat),
+                        "telemetry": bool(tel_knobs.get("telemetry")),
+                        "guard_nonfinite": bool(
+                            tel_knobs.get("guard_nonfinite")
+                        ),
+                        "shard_opt_state": bool(shard_opt_state),
+                        "loss_fn": getattr(
+                            loss_fn, "__qualname__", str(loss_fn)
+                        ),
+                        "forward_loss": (
+                            getattr(forward_loss, "__qualname__",
+                                    str(forward_loss))
+                            if forward_loss is not None else None
+                        ),
+                        "input_key": input_key,
+                        "label_key": label_key,
+                        "dropout_seed": seed,
+                        "model": model_id,
+                    },
+                )
+                cc_handle = cc.begin_load(cc_key)
+        except Exception as exc:
+            bringup_events.append({
+                "tag": "compile_cache_unsupported",
+                "reason": f"{type(exc).__name__}: {exc}"[:300],
+            })
+            cc = None
     try:
         if checkpoint_dir is not None:
             from tpudist.checkpoint import Checkpointer
@@ -1004,6 +1118,20 @@ def fit(
             # inside try/finally so the manager's async-checkpointing threads
             # are torn down even when bring-up below raises
             ckpt = Checkpointer(checkpoint_dir)
+            if chaos_inj is not None:
+                # the corrupt@step drill truncates the newest checkpoint:
+                # bind the target and the settle hook so it corrupts a
+                # deterministic, already-committed step
+                chaos_inj.bind(checkpoint_dir, wait=ckpt.wait)
+            # finish or roll back an elastic commit a previous life
+            # crashed mid-way: adopt the committed new-world save (its
+            # marker meta becomes THE meta — without this, a crash
+            # between the barrier-save and the meta flip would re-reshard
+            # an already-resharded checkpoint, double-remapping the
+            # cursor) or rename the quarantined old steps back
+            ckpt.recover_interrupted_reshard()
+            resharded = False
+            did_restore = False
             if ckpt.latest_step() is not None:
                 if not resume:
                     raise ValueError(
@@ -1013,20 +1141,119 @@ def fit(
                         "later resume) — use a fresh checkpoint_dir"
                     )
                 saved_meta = ckpt.read_meta()
-                if saved_meta is not None and saved_meta != run_meta:
-                    raise ValueError(
-                        f"checkpoint at {checkpoint_dir} was written by a run "
-                        f"with different geometry ({saved_meta} != {run_meta}); "
-                        "state.step would map to the wrong data position — "
-                        "resume with the original settings or start a fresh "
-                        "checkpoint_dir"
+                from tpudist.resilience import elastic as elastic_mod
+
+                if saved_meta is not None and not elastic_mod.meta_matches(
+                    saved_meta, run_meta
+                ):
+                    reason = elastic_mod.refusal_reason(
+                        saved_meta, run_meta
                     )
+                    if not elastic or reason is not None:
+                        hint = (
+                            " — this is a pure world resize; pass "
+                            "fit(elastic=True) to reshard onto the live "
+                            "mesh (docs/MULTIHOST.md)"
+                            if reason is None else ""
+                        )
+                        raise ValueError(
+                            f"checkpoint at {checkpoint_dir} was written by "
+                            f"a run with different geometry ({saved_meta} "
+                            f"!= {run_meta}); state.step would map to the "
+                            "wrong data position — resume with the "
+                            "original settings or start a fresh "
+                            f"checkpoint_dir{hint}"
+                        )
+                    resharded = True
                 t_restore = time.perf_counter()
-                state = ckpt.restore(like=state)
+                state = ckpt.restore(
+                    like=state, reshard=resharded, run_meta=run_meta,
+                    mesh=mesh, fallback=True,
+                    on_event=bringup_events.append,
+                )
                 if gp is not None:
                     gp.add("restore_s", time.perf_counter() - t_restore)
+                did_restore = True
                 start_step = int(state.step)
+                for ev in bringup_events:
+                    # a step the fallback walked past failed to
+                    # deserialize: set it aside (never delete — the
+                    # failure may be transient I/O and the dir may still
+                    # hold the healthy newest state), or it keeps
+                    # shadowing latest_step AND blocks orbax's monotonic
+                    # save order for every cadence save below its number
+                    if ev.get("tag") == "checkpoint_fallback":
+                        ckpt.quarantine_failed_step(ev["failed_step"])
+                if resharded:
+                    # commit the resharded world: the old-geometry step
+                    # dirs are uninterpretable under the remapped counter
+                    # (and may collide with its numbering), so quarantine
+                    # them, barrier-save the new-world state, flip the
+                    # meta atomically, and only then purge — a crash at
+                    # any point leaves a restorable directory (see
+                    # Checkpointer's reshard-commit protocol)
+                    t_save = time.perf_counter()
+                    ckpt.quarantine_steps(commit_meta=run_meta)
+                    ckpt.save(state, wait=True)
+                    if gp is not None:
+                        gp.add(
+                            "checkpoint_s", time.perf_counter() - t_save
+                        )
             ckpt.write_meta(run_meta)
+            ckpt.purge_quarantined()
+
+        if cc is not None:
+            from tpudist import compile_cache as cc_mod
+
+            # join the background deserialization (it overlapped the
+            # restore above); a miss AOT-compiles HERE — bring-up, where
+            # goodput attributes it as compile_s — and stores the
+            # executable for the next generation. Either way iteration 1
+            # becomes an ordinary step.
+            exe, cc_info = cc.finish(
+                cc_handle, step, state, cc_staged, cc_key,
+                meta={"job_id": job_id},
+            )
+            if exe is not None:
+                if ckpt is not None and did_restore:
+                    # jax 0.4.x XLA:CPU compat: an AOT executable must
+                    # not donate orbax-restored buffers (heap corruption;
+                    # no-op off the wart platform — see launder_restored).
+                    # Keyed on the RESTORE having happened, not on the
+                    # step number: an emergency save at step 0 restores
+                    # orbax buffers all the same.
+                    state = cc_mod.launder_restored(state)
+
+                def _aot_fallback(exc):
+                    # first-call validation failed (a geometry the key
+                    # could not see): permanent fall-through to tracing,
+                    # surfaced in the stream — never a silent wrong
+                    # guess. Iteration 1 now pays a REAL trace+compile,
+                    # so goodput reverts to the cold attribution too.
+                    if gp is not None:
+                        gp.clear_precompiled()
+                    if tel_box:
+                        tel_box[0].warn(
+                            "compile_cache_fallback",
+                            error=f"{type(exc).__name__}: {exc}"[:300],
+                        )
+
+                step = cc_mod.wrap_step(
+                    step, exe, on_fallback=_aot_fallback,
+                    expected_batch=cc_staged,
+                )
+                if gp is not None:
+                    gp.set_precompiled(warm=bool(cc_info.get("hit")))
+                    if cc_info.get("hit"):
+                        # only the NON-overlapped wait: the load ran
+                        # concurrently with the restore, and the goodput
+                        # partition is disjoint by contract
+                        gp.add(
+                            "cache_load_s",
+                            cc_info.get("load_wait_s", 0.0),
+                        )
+                    else:
+                        gp.add("compile_s", cc_info.get("compile_s", 0.0))
 
         start_epoch = start_step // steps_per_epoch if steps_per_epoch else 0
         skip_batches = start_step % steps_per_epoch if steps_per_epoch else 0
@@ -1069,6 +1296,19 @@ def fit(
                     # this generation will overwrite
                     gp.load_previous(tel.health.report_path)
                 logger.attach_sink(tel.sink)
+                tel_box.append(tel)
+                # replay bring-up diagnoses that predate the sink: the
+                # elastic reshard record, checkpoint-fallback warnings,
+                # and the AOT-cache outcome
+                for ev in bringup_events:
+                    ev = dict(ev)
+                    tag = ev.pop("tag")
+                    if tag == "reshard":
+                        tel.set_reshard(ev)
+                    else:
+                        tel.warn(tag, **ev)
+                if cc_info is not None:
+                    tel.set_compile_cache(cc_info)
                 if fused is not None:
                     # one-time fusion config row: which kernels this run's
                     # compiled step actually engaged — the attribution a
