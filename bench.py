@@ -119,6 +119,17 @@ Legs
    plus the ``vs_cold`` ratio and the goodput breakdown of both, since
    compile is the dominant recurring restart term the cache exists to
    delete (tpudist/compile_cache.py).
+17. ``gpt2_124m_repair_recovery_s`` — the self-healing loop's drill
+   (docs/MULTIHOST.md "Recovering from loss spikes and SDCs"): a
+   supervised 124M run takes a chaos ``bitflip@k`` SDC; the
+   replica-divergence probe flags it, ``fit(repair=...)`` rolls back to
+   the health-anchored checkpoint, skips the window, and finishes —
+   IN-PROCESS, one generation, no restart. value = the repair's total
+   cost in wall seconds (``goodput.repair_s + repair_replay_s`` — the
+   machinery plus the discarded step work); the record carries the
+   detect-to-trigger latency in steps and seconds (trigger step − flip
+   step, × the run's p50 step time), the rollback/skip window, and
+   vs_baseline = target / value (>= 1.0 lands under the bound).
 
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
@@ -1823,6 +1834,134 @@ def bench_preempt_recovery() -> None:
     )
 
 
+TARGET_REPAIR_RECOVERY_S = 120.0  # a repair must cost < 2 min of goodput
+
+_REPAIR_CHILD = """
+import os
+
+if os.environ.get("TPUDIST_FORCE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+import jax
+import numpy as np
+import optax
+
+from tpudist import create_mesh, init_from_env
+from tpudist.data.loader import DataLoader
+from tpudist.models.gpt2 import GPT2
+from tpudist.telemetry import TelemetryConfig
+from tpudist.train import fit, lm_loss
+
+ctx = init_from_env()
+mesh = create_mesh()
+out = os.environ["OUT_DIR"]
+n = jax.device_count()
+seq, per_chip, n_batches = 256, 4, 32
+rng = np.random.Generator(np.random.PCG64(0))
+tokens = rng.integers(
+    0, 50257, (per_chip * n * n_batches, seq)
+).astype(np.int32)
+loader = DataLoader({"tokens": tokens}, per_chip * n)
+model = GPT2(max_seq_len=seq, mesh=mesh)  # the 124M geometry
+cfg = TelemetryConfig(sentry=False, mfu=False, breakdown=False,
+                      heartbeat_every=0, divergence_every=2)
+# an SDC lands after step 10; the divergence probe flags it within two
+# cadences, the repair loop rolls back to the anchored save, skips the
+# window, and the run finishes IN-PROCESS with finite loss — the whole
+# incident priced by the goodput repair components in the report
+fit(
+    model, optax.adam(1e-4), loader,
+    epochs=1, mesh=mesh, profile=False,
+    job_id="RepairBench", log_dir=out,
+    loss_fn=lm_loss, input_key="tokens", label_key="tokens",
+    telemetry=cfg,
+    checkpoint_dir=os.path.join(out, "ckpt"), checkpoint_every=3,
+    repair={"skip_window": 4, "anchor_clean_steps": 5},
+    chaos="bitflip@10",
+)
+"""
+
+
+def bench_repair_recovery() -> None:
+    """The self-healing drill (leg 17): a bitflip SDC mid-run, detected
+    by the divergence probe and repaired by rollback-and-skip, priced
+    from the run report. Supervised like the preempt leg (fresh attach,
+    kill switch) even though the repair itself never leaves the
+    process."""
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+
+    out = pathlib.Path(tempfile.mkdtemp(prefix="tpudist_repair_bench_"))
+    script = out / "child.py"
+    script.write_text(_REPAIR_CHILD)
+    env = dict(os.environ)
+    env["OUT_DIR"] = str(out)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "tpudist.launch",
+            "--nproc_per_node=1", "--max_restarts=0",
+            f"--master_port={29500 + os.getpid() % 499 + 1}",
+            str(script),
+        ],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=2100,
+    )
+    wall = time.perf_counter() - t0
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"repair-recovery drill failed rc={r.returncode}:\n"
+            f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+        )
+    report = json.loads((out / "RepairBench_report.json").read_text())
+    good = report["goodput"]
+    repairs = report["repairs"]
+    assert repairs and repairs[0]["action"] == "rollback", repairs
+    assert report["status"] == "completed", report["status"]
+    rep = repairs[0]
+    repair_cost_s = good["repair_s"] + good["repair_replay_s"]
+    p50 = (report.get("step_time_s") or {}).get("p50") or 0.0
+    detect_steps = max(int(rep["cause"].get("step", rep["skip_from"])) - 10, 0)
+    _record_line(
+        {
+            "metric": "gpt2_124m_repair_recovery_s",
+            "value": round(repair_cost_s, 3),
+            "unit": "wall seconds one silent-data-corruption incident "
+            "costs end to end under the self-healing loop (chaos "
+            "bitflip@10 on a supervised GPT-2 124M run): repair "
+            f"machinery {round(good['repair_s'], 3)}s + discarded step "
+            f"work {round(good['repair_replay_s'], 3)}s — "
+            "goodput.repair_s + repair_replay_s from the run report; "
+            f"detected {detect_steps} steps after the flip "
+            f"(~{round(detect_steps * p50, 2)}s at p50 step time), "
+            f"rolled back to step {rep['rollback_step']} "
+            f"(anchored={rep['anchored']}), skipped to {rep['skip_to']}, "
+            "run finished IN-PROCESS with finite loss (whole drill: "
+            f"{round(wall, 1)}s wall); vs_baseline = "
+            f"{TARGET_REPAIR_RECOVERY_S:.0f}s target / value — >= 1.0 "
+            "means the incident costs under the bound "
+            "(docs/MULTIHOST.md)",
+            "repair_machinery_s": round(good["repair_s"], 3),
+            "repair_replay_s": round(good["repair_replay_s"], 3),
+            "detect_latency_steps": detect_steps,
+            "detect_latency_s": round(detect_steps * p50, 3),
+            "rollback_step": rep["rollback_step"],
+            "anchored": bool(rep["anchored"]),
+            "skip_from": rep["skip_from"],
+            "skip_to": rep["skip_to"],
+            "discarded_steps": rep["discarded_steps"],
+            "repairs": good["repairs"],
+            "vs_baseline": round(
+                TARGET_REPAIR_RECOVERY_S / max(repair_cost_s, 1e-9), 4
+            ),
+        }
+    )
+
+
 def bench_comm_efficiency() -> None:
     """The communication-efficiency legs (docs/PERF.md §11).
 
@@ -1960,6 +2099,10 @@ _LEG_GROUPS = {
     # two full trainer generations (the resumed one recompiles through
     # the persistent cache) + the supervised relaunch between them
     "preempt": (bench_preempt_recovery, 4500),
+    # one supervised trainer generation: compile + ~32 steps with a
+    # mid-run rollback-and-skip repair (restore + a handful of replayed
+    # steps) — no relaunch, so roughly half the preempt leg's budget
+    "repair": (bench_repair_recovery, 2400),
 }
 
 

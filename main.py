@@ -172,10 +172,35 @@ def parse_args(argv=None):
                         "checkpoint; docs/MULTIHOST.md)")
     parser.add_argument("--chaos", default=None, type=str,
                         help="fault injection for recovery drills "
-                        "(tpudist.resilience.chaos): '<kind>[:<seconds>]"
-                        "@<step>[@<generation>|@*]' with kind in "
-                        "crash/hang/sigterm — e.g. 'sigterm@50' rehearses "
-                        "a preemption after step 50 of generation 0")
+                        "(tpudist.resilience.chaos): '<kind>[:<n>]"
+                        "@<step>[@<generation>|@*]' with kind in crash/"
+                        "hang/sigterm/corrupt/bitflip/nanburst, comma-"
+                        "separable — e.g. 'sigterm@50' rehearses a "
+                        "preemption, 'bitflip@50' an SDC, "
+                        "'bitflip@10,nanburst:3@30' composes an SDC with "
+                        "a later spike in one drill")
+    parser.add_argument("--repair", action="store_true",
+                        help="self-healing loop (tpudist.resilience."
+                        "repair, docs/MULTIHOST.md): detector verdicts "
+                        "(replica divergence, non-finite skip streaks, "
+                        "sustained loss spikes) roll state back to the "
+                        "last-known-good ANCHORED checkpoint, skip "
+                        "--skip_window batches past the trigger, and "
+                        "continue in-process; repeat triggers exit 77 "
+                        "for a supervised relaunch, a rolling budget "
+                        "circuit-breaks deterministic poison. Needs "
+                        "--checkpoint_dir + a save cadence; implies "
+                        "--telemetry (combine with --health for the "
+                        "SDC/divergence trigger)")
+    parser.add_argument("--skip_window", default=8, type=int,
+                        help="with --repair: batches skipped past a "
+                        "trigger on rollback (the presumed-offending "
+                        "data window)")
+    parser.add_argument("--keep_last", default=0, type=int,
+                        help="checkpoint retention: keep only the newest "
+                        "N step dirs (health-anchored steps exempt — "
+                        "they are the repair rollback target); 0 keeps "
+                        "the legacy orbax max_to_keep=3 behavior")
     parser.add_argument("--serve", action="store_true",
                         help="continuous-batching serving demo "
                         "(tpudist.serve, docs/SERVING.md): a byte-vocab "
@@ -535,9 +560,13 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         checkpoint_every_s=args.checkpoint_every_s or None,
+        keep_last=args.keep_last or None,
         resume=not args.no_resume,
         elastic=args.elastic,
         compile_cache=args.compile_cache,
+        repair=(
+            {"skip_window": args.skip_window} if args.repair else None
+        ),
         chaos=args.chaos,
     )
 

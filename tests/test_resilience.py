@@ -36,15 +36,18 @@ from tpudist.resilience import (
 # -- exit codes --------------------------------------------------------------
 
 def test_exit_code_contract():
-    assert EXIT_PREEMPTED == 75 and EXIT_HANG == 76
-    assert is_restartable(75) and is_restartable(76)
+    from tpudist.resilience import EXIT_REPAIR
+
+    assert EXIT_PREEMPTED == 75 and EXIT_HANG == 76 and EXIT_REPAIR == 77
+    assert is_restartable(75) and is_restartable(76) and is_restartable(77)
     # crashes, signal deaths (negative from Popen), and operator stops
     # are NOT deliberate checkpoint-and-exit codes
-    for rc in (0, 1, 9, 130, -9, -15, 77):
+    for rc in (0, 1, 9, 130, -9, -15, 78):
         assert not is_restartable(rc)
     assert classify(0) == "ok"
     assert classify(EXIT_INTERRUPT) == "stop"
     assert classify(75) == "restartable" and classify(76) == "restartable"
+    assert classify(77) == "restartable"
     assert classify(1) == "crash" and classify(-9) == "crash"
 
 

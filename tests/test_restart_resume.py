@@ -109,16 +109,21 @@ _RESILIENCE_CHILD = textwrap.dedent("""
         sentry=False, mfu=False, heartbeat_every=4,
         hang_timeout_s=float(os.environ.get("HANG_TIMEOUT_S", 0)) or None,
         hang_action=os.environ.get("HANG_ACTION", "report"),
+        # the repair/SDC drills need the replica-divergence probe
+        divergence_every=int(os.environ.get("DIV_EVERY", 0) or 0),
     )
     state, losses = fit(
         TinyMlp(), optax.adam(1e-2), loader,
-        epochs=4, mesh=mesh, profile=False,
+        epochs=int(os.environ.get("EPOCHS", 4)), mesh=mesh, profile=False,
         job_id="SP", log_dir=out, batch_size=16,
         world_size=ctx.world_size, global_rank=ctx.process_index,
         telemetry=cfg,
         checkpoint_dir=os.path.join(out, "ckpt"),
         checkpoint_every=int(os.environ.get("CKPT_EVERY", 4)),
         chaos=os.environ.get("CHAOS") or None,
+        # the self-healing drills: rollback-and-skip repair loop
+        repair=(json.loads(os.environ["REPAIR"])
+                if os.environ.get("REPAIR") else None),
         # the elastic/warm-start drills: cross-world resume + AOT cache
         reduce=os.environ.get("REDUCE", "none"),
         shard_opt_state=bool(os.environ.get("SHARD_OPT")),
@@ -265,6 +270,51 @@ def test_chaos_sigterm_two_process_world_resumes(tmp_path):
     report = json.loads((tmp_path / "SP_report.json").read_text())
     assert report["generation"] == 1
     assert report["goodput"]["generations"][0]["exit_reason"] == "preempted"
+
+
+def test_repair_restart_escalation_and_budget_circuit_breaker(tmp_path):
+    """The self-healing ladder under the REAL supervisor, against a
+    DETERMINISTIC poison (``bitflip@5@*`` re-arms after every repair and
+    every relaunch): generation 0 repairs in-process once (rollback to
+    the anchored save + skip), the re-poisoned state re-triggers inside
+    the repeat window → exit 77 with a durable rollback-and-skip
+    directive; the supervisor relaunches on the restartable fast path;
+    generation 1 consumes the directive, the poison bites again, and the
+    rolling repair budget (max_repairs=2) circuit-breaks the job to a
+    NON-ZERO exit instead of spinning forever."""
+    r = _launch_resilience_child(
+        tmp_path,
+        {
+            "CHAOS": "bitflip@5@*",
+            "DIV_EVERY": "2",
+            "CKPT_EVERY": "2",
+            "EPOCHS": "10",
+            "REPAIR": json.dumps({
+                "skip_window": 2, "anchor_clean_steps": 5,
+                "repeat_window": 8, "max_repairs": 2,
+            }),
+        },
+        ["--nproc_per_node=1", "--emulate-devices=4", "--max_restarts=0"],
+    )
+    # the circuit breaker turned the deterministic poison into a
+    # terminal non-zero exit — never rc 0, never an endless 77 loop
+    assert r.returncode != 0, r.stdout + r.stderr
+    assert "rc=77 (restartable); restarting generation 1" in r.stderr
+    blob = json.loads(
+        (tmp_path / "ckpt" / "tpudist_repair.json").read_text()
+    )
+    actions = [e["action"] for e in blob["history"]]
+    assert "rollback" in actions and "restart" in actions
+    # every rollback targeted a PRE-flip save: the anchored retention
+    # never handed back a checkpoint written while the SDC incubated
+    assert all(e["rollback_step"] <= 5 for e in blob["history"])
+    report = json.loads((tmp_path / "SP_report.json").read_text())
+    assert report["status"] == "crashed:RepairExhausted"
+    assert report["generation"] == 1
+    # one file reconstructs the incident timeline: the full repair
+    # history plus the supervisor's per-generation exit codes
+    assert [e["action"] for e in report["repairs"]] == actions
+    assert report["supervisor_exit_history"] == [77]
 
 
 def test_crash_restart_resumes_from_checkpoint(tmp_path):

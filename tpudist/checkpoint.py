@@ -43,6 +43,39 @@ QUARANTINE_DIR = "_pre_reshard"
 #: seeing them. A digit-free name, same rule as QUARANTINE_DIR.
 FAILED_DIR = "_failed"
 
+#: the last-known-good marker (``tpudist.resilience.repair``): a step is
+#: recorded here only after K subsequent steps with clean health metrics
+#: promoted it, so the repair loop's rollback target is never a
+#: checkpoint written mid-incubating-spike. Anchored steps are exempt
+#: from ``keep_last`` pruning.
+ANCHOR_FILE = "tpudist_anchor.json"
+
+
+def atomic_write_json(directory: Path, name: str, obj) -> None:
+    """Write ``obj`` as JSON at ``directory/name`` atomically (sibling
+    tmp + fsync + ``os.replace``): a preemption landing mid-write must
+    never leave a torn half-JSON that poisons the next generation's
+    bring-up. The one write discipline every run-metadata file here
+    (geometry meta, anchor, repair state) shares."""
+    import json
+
+    directory = Path(directory)
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=f".{name}.", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(obj))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, directory / name)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
 
 @dataclasses.dataclass
 class Checkpointer:
@@ -56,6 +89,22 @@ class Checkpointer:
 
     directory: str | Path
     max_to_keep: int = 3
+    #: retention knob (``fit(keep_last=)`` / ``main.py --keep_last``):
+    #: when set, orbax's own max_to_keep is DISABLED and this class
+    #: prunes after each save instead, keeping the newest ``keep_last``
+    #: step dirs PLUS the health-anchored step (``read_anchor``) — the
+    #: repair loop's rollback target must survive retention, which
+    #: orbax's purely-newest policy cannot express. ``None`` keeps the
+    #: legacy orbax ``max_to_keep`` behavior byte-identical.
+    keep_last: int | None = None
+    #: optional callable returning extra step numbers ``_prune`` must
+    #: keep. fit wires the repair controller's ``protected_steps`` here:
+    #: anchor CANDIDATES (saves still inside their clean-step promotion
+    #: window) must survive retention, or a promotion at step S+K would
+    #: stamp the anchor file with a step dir ``keep_last`` newer saves
+    #: already deleted — and the first rollback would die on a missing
+    #: checkpoint instead of self-healing.
+    protect_steps: object = None
 
     def __post_init__(self):
         self.directory = Path(self.directory).absolute()
@@ -65,7 +114,9 @@ class Checkpointer:
         return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=self.max_to_keep,
+                max_to_keep=(
+                    None if self.keep_last is not None else self.max_to_keep
+                ),
                 enable_async_checkpointing=True,
             ),
             # registers the standard handler at construction: a FRESH
@@ -93,7 +144,34 @@ class Checkpointer:
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
+        if saved and self.keep_last is not None:
+            self._prune()
         return saved
+
+    def _prune(self) -> None:
+        """keep_last retention: delete everything but the newest
+        ``keep_last`` steps and the anchored step. Fail-soft — retention
+        must never kill training over a racing delete or a permission
+        hiccup — and orbax's own ``delete`` does the multi-process
+        coordination (primary-host surgery)."""
+        keep = max(int(self.keep_last), 1)
+        steps = self.all_steps()
+        protect = set(steps[-keep:])
+        anchor = self.read_anchor()
+        if anchor is not None:
+            protect.add(int(anchor))
+        if self.protect_steps is not None:
+            try:
+                protect.update(int(s) for s in self.protect_steps())
+            except Exception:
+                pass
+        for s in steps:
+            if s in protect:
+                continue
+            try:
+                self._mgr.delete(s)
+            except Exception:
+                pass
 
     def wait(self) -> None:
         """Block until in-flight async saves are durable."""
@@ -412,34 +490,35 @@ class Checkpointer:
     # guards resume against a changed run geometry (batch size / world size
     # shift the meaning of state.step, silently corrupting the data order)
     def write_meta(self, meta: dict) -> None:
-        import json
-
         if jax.process_index() == 0:
-            # atomic: a preemption landing mid-write must never leave a
-            # torn half-JSON that poisons the next generation's resume
-            # validation — write a sibling tmp file and os.replace it in
-            target = self.directory / "tpudist_meta.json"
-            fd, tmp = tempfile.mkstemp(
-                dir=self.directory, prefix=".tpudist_meta.", suffix=".tmp"
-            )
-            try:
-                with os.fdopen(fd, "w") as f:
-                    f.write(json.dumps(meta))
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, target)
-            except BaseException:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+            atomic_write_json(self.directory, "tpudist_meta.json", meta)
 
     def read_meta(self) -> dict | None:
         import json
 
         p = self.directory / "tpudist_meta.json"
         return json.loads(p.read_text()) if p.exists() else None
+
+    # -- last-known-good anchor (tpudist.resilience.repair) ----------------
+    def write_anchor(self, step: int) -> None:
+        """Promote ``step`` to the last-known-good rollback target. The
+        PROMOTION rule (K clean health steps after the save) lives in
+        the repair controller — this is only the durable marker, shared
+        by ``_prune``'s exemption and the next generation's bring-up."""
+        if jax.process_index() == 0:
+            atomic_write_json(self.directory, ANCHOR_FILE,
+                              {"step": int(step)})
+
+    def read_anchor(self) -> int | None:
+        import json
+
+        p = self.directory / ANCHOR_FILE
+        if not p.exists():
+            return None
+        try:
+            return int(json.loads(p.read_text())["step"])
+        except (ValueError, KeyError, TypeError, OSError):
+            return None
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

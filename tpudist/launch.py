@@ -24,7 +24,8 @@ via ``--emulate-devices``).
 
 Beyond the reference's fail-fast, the launcher is a SUPERVISOR
 (``tpudist.resilience.supervisor``): exit codes 75 (preempted) / 76
-(watchdog hang) mean the trainer persisted its state and asked to be
+(watchdog hang) / 77 (repair-restart) mean the trainer persisted its
+state and asked to be
 relaunched — those restart promptly regardless of ``--max_restarts``,
 bounded by the ``--restart_budget``/``--restart_window`` rolling window;
 any other non-zero exit is a crash, restarted only within
@@ -71,11 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--max_restarts", type=int, default=0,
         help="relaunch this node's processes up to N times after a CRASH "
-        "(any non-zero exit other than the restartable codes 75/76) — "
+        "(any non-zero exit other than the restartable codes 75/76/77) — "
         "elastic-style recovery beyond the reference's fail-fast "
         "(SURVEY.md §5); pair with the trainer's --checkpoint_dir so the "
         "relaunched run resumes from the last checkpoint. 0 = fail fast "
-        "on crashes. Restartable exits (preempted=75, watchdog hang=76) "
+        "on crashes. Restartable exits (preempted=75, watchdog hang=76, repair-restart=77) "
         "restart regardless, bounded only by the restart budget.",
     )
     p.add_argument(
@@ -94,7 +95,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="first crash-restart delay (seconds); doubles per consecutive "
         "crash up to --backoff_max, with ±50%% jitter so a fleet of "
         "launchers never stampedes the rendezvous port in lockstep. "
-        "Restartable exits (75/76) relaunch without backoff.",
+        "Restartable exits (75/76/77) relaunch without backoff.",
     )
     p.add_argument(
         "--backoff_max", type=float, default=60.0,

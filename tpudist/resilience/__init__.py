@@ -21,6 +21,14 @@ the whole run:
 - :mod:`~tpudist.resilience.chaos` — deterministic crash/hang/SIGTERM/
   checkpoint-corruption injection (``main.py --chaos``, the recovery
   tests, the bench's ``gpt2_124m_preempt_recovery_s`` leg);
+- :mod:`~tpudist.resilience.repair` — the self-healing loop
+  (``fit(repair=...)``): detector verdicts (replica divergence, skip
+  streaks, sustained loss spikes) execute an in-process escalation
+  ladder — roll back to the last-known-good ANCHORED checkpoint, skip
+  the offending data window with a redrawn RNG salt, exit 77 for a
+  supervised relaunch on a repeat trigger, and circuit-break a
+  deterministic poison on a rolling repair budget (docs/MULTIHOST.md
+  "Recovering from loss spikes and SDCs");
 - :mod:`~tpudist.resilience.elastic` — cross-world-size checkpoint
   resharding (``fit(elastic=True)``): ZeRO-1 pad-and-reshape layouts
   re-laid onto the surviving mesh, error-feedback residual flushed,
@@ -37,7 +45,9 @@ from tpudist.resilience.chaos import (
     ChaosInjector,
     ChaosSpec,
     corrupt_latest_checkpoint,
+    flip_param_bit,
     make_injector,
+    parse_chaos,
 )
 from tpudist.resilience.elastic import (
     ElasticRefusal,
@@ -48,16 +58,26 @@ from tpudist.resilience.elastic import (
 from tpudist.resilience.exitcodes import (
     EXIT_CRASH,
     EXIT_HANG,
+    EXIT_HISTORY_ENV,
     EXIT_INTERRUPT,
     EXIT_OK,
     EXIT_PREEMPTED,
+    EXIT_REPAIR,
     GENERATION_ENV,
     RESTARTABLE,
+    exit_history,
     is_restartable,
     restart_generation,
 )
 from tpudist.resilience.goodput import GoodputTracker
 from tpudist.resilience.preempt import Preempted, PreemptionGuard
+from tpudist.resilience.repair import (
+    RepairController,
+    RepairExhausted,
+    RepairPolicy,
+    RepairRestart,
+    resolve_policy,
+)
 from tpudist.resilience.supervisor import (
     BackoffPolicy,
     RestartBudget,
@@ -70,11 +90,14 @@ __all__ = [
     "EXIT_CRASH",
     "EXIT_PREEMPTED",
     "EXIT_HANG",
+    "EXIT_REPAIR",
     "EXIT_INTERRUPT",
     "RESTARTABLE",
     "GENERATION_ENV",
+    "EXIT_HISTORY_ENV",
     "is_restartable",
     "restart_generation",
+    "exit_history",
     "Preempted",
     "PreemptionGuard",
     "BackoffPolicy",
@@ -86,9 +109,16 @@ __all__ = [
     "ChaosSpec",
     "ChaosInjector",
     "make_injector",
+    "parse_chaos",
     "corrupt_latest_checkpoint",
+    "flip_param_bit",
     "ElasticRefusal",
     "elastic_mismatch",
     "remap_step",
     "reshard_restore",
+    "RepairPolicy",
+    "RepairController",
+    "RepairRestart",
+    "RepairExhausted",
+    "resolve_policy",
 ]

@@ -15,13 +15,19 @@ and the supervisor (``tpudist.launch`` → :mod:`tpudist.resilience
 - ``EXIT_HANG`` (76, EX_PROTOCOL): the hang watchdog tripped, the crash
   forensics are on disk, and ``hang_action="exit"`` terminated the wedged
   process — relaunch from the last checkpoint.
+- ``EXIT_REPAIR`` (77, EX_NOPERM — reused: clear of every shell/signal
+  convention): the in-process repair loop (``tpudist.resilience.repair``)
+  hit a REPEAT trigger inside the window it had just repaired, persisted
+  a rollback-and-skip directive next to the checkpoints, and asked for a
+  fresh process — relaunch; bring-up consumes the directive (restore the
+  anchored checkpoint, skip further past the offending window).
 - ``EXIT_INTERRUPT`` (130, 128+SIGINT): operator Ctrl-C at the launcher —
   never restarted.
 - anything else non-zero is a crash: restarted only within the legacy
   ``--max_restarts`` attempt budget (with backoff), never on the
   restartable fast path.
 
-75/76 sit in the 64..78 sysexits range, clear of shell conventions
+75/76/77 sit in the 64..78 sysexits range, clear of shell conventions
 (126/127), signal deaths (128+N), and ordinary ``sys.exit(1)`` crashes —
 a launcher that predates this contract treats them as generic failures
 and still recovers via ``--max_restarts``, just without the
@@ -36,16 +42,23 @@ EXIT_OK = 0
 EXIT_CRASH = 1
 EXIT_PREEMPTED = 75
 EXIT_HANG = 76
+EXIT_REPAIR = 77
 EXIT_INTERRUPT = 130
 
 #: codes whose meaning is "state is durable, relaunch me" — the trainer
 #: exited deliberately after persisting what it could
-RESTARTABLE = frozenset({EXIT_PREEMPTED, EXIT_HANG})
+RESTARTABLE = frozenset({EXIT_PREEMPTED, EXIT_HANG, EXIT_REPAIR})
 
 #: the supervisor exports each world's generation under this name; rank
 #: telemetry reads it so heartbeats/reports are attributable across the
 #: lives of one logical job (0 = first launch)
 GENERATION_ENV = "TPUDIST_RESTART_GENERATION"
+
+#: the supervisor exports the exit codes of every PREVIOUS generation of
+#: this job under this name (comma-separated, oldest first; unset/empty on
+#: a first launch) — the run report records it, so one file reconstructs
+#: the incident timeline across the lives of the job
+EXIT_HISTORY_ENV = "TPUDIST_EXIT_HISTORY"
 
 
 def is_restartable(rc: int) -> bool:
@@ -63,3 +76,21 @@ def restart_generation(environ=None) -> int:
         return int(raw)
     except (TypeError, ValueError):
         return 0
+
+
+def exit_history(environ=None) -> list[int]:
+    """The exit codes of this job's previous generations
+    (``TPUDIST_EXIT_HISTORY``, oldest first; ``[]`` on a first launch or
+    under a supervisor that predates the variable). Garbage entries are
+    dropped, not fatal — accounting, never a crash source."""
+    raw = (environ or os.environ).get(EXIT_HISTORY_ENV, "")
+    out = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            out.append(int(part))
+        except ValueError:
+            continue
+    return out

@@ -33,6 +33,17 @@ through the ``{job}_report.json`` each generation leaves behind:
 - ``checkpoint_s`` — seconds blocked in checkpoint saves, including the
   synchronous emergency save (also reported separately as
   ``emergency_save_s``, a subset of ``checkpoint_s``);
+- ``repair_s`` — seconds spent executing in-process repairs
+  (``tpudist.resilience.repair``: the anchored-checkpoint restore, the
+  residual flush, the cursor jump);
+- ``repair_replay_s`` — the wall seconds of STEP WORK a repair's rollback
+  discarded (measured step intervals of the rolled-back span). Those
+  seconds were counted productive while they ran; booking them here
+  reclassifies them out of the productive residual, which is the honest
+  price of a repair — the repaired run re-earns that progress on clean
+  data. A second-order overlap with ``data_wait_s`` (the discarded
+  steps' input waits are in both) is accepted: the residual clamps at
+  zero and the repair legs read this component, not the residual;
 - ``productive_step_s`` — the residual: total minus everything above.
   Computing productive time as the residual is what makes the components
   sum to the generation's wall time *exactly* (the report's acceptance
@@ -67,6 +78,8 @@ COMPONENTS = (
     "cache_load_s",
     "data_wait_s",
     "checkpoint_s",
+    "repair_s",
+    "repair_replay_s",
 )
 
 
@@ -81,6 +94,7 @@ class GoodputTracker:
         self.start_wall = wall()
         self._parts = {k: 0.0 for k in COMPONENTS}
         self.emergency_save_s = 0.0
+        self.repairs = 0
         self.steps = 0
         self._loop_t: float | None = None
         self._first_step_done = False
@@ -111,6 +125,15 @@ class GoodputTracker:
         separately — it is the per-incident recovery cost."""
         self.add("checkpoint_s", seconds)
         self.emergency_save_s += max(float(seconds), 0.0)
+
+    def add_repair(self, overhead_s: float, replay_s: float = 0.0) -> None:
+        """One executed repair (``tpudist.resilience.repair``):
+        ``overhead_s`` is the machinery (restore + flush + cursor jump),
+        ``replay_s`` the discarded step work the rollback threw away —
+        both reclassified out of the productive residual."""
+        self.add("repair_s", overhead_s)
+        self.add("repair_replay_s", replay_s)
+        self.repairs += 1
 
     def set_precompiled(self, warm: bool = False) -> None:
         """The step executable exists BEFORE the loop (AOT path:
@@ -174,6 +197,7 @@ class GoodputTracker:
             **{k: round(v, 6) for k, v in self._parts.items()},
             "emergency_save_s": round(self.emergency_save_s, 6),
             "warm_start": bool(self._warm),
+            "repairs": self.repairs,
             "steps": self.steps,
             "start_wall": round(self.start_wall, 3),
             "end_wall": round(self._wall(), 3),
@@ -210,6 +234,11 @@ class GoodputTracker:
             "productive_step_s": round(productive, 6),
             "restart_gap_s": round(sum(gaps), 6),
             "restart_overhead_s": round(restart_overhead, 6),
+            "repair_overhead_s": round(
+                sum(g.get("repair_s", 0.0) + g.get("repair_replay_s", 0.0)
+                    for g in gens), 6
+            ),
+            "repairs": sum(int(g.get("repairs", 0) or 0) for g in gens),
             "productive_frac": round(
                 productive / total if total > 0 else 0.0, 6
             ),

@@ -1,0 +1,538 @@
+"""Self-healing training: the automatic rollback-and-skip repair loop.
+
+The robustness stack *detects* trouble (PR 7: replica-divergence
+bit-checksums, the NanSentry loss-spike verdict, the in-graph
+``guard_nonfinite`` skip) and *survives process death* (the PR 8
+supervisor, PR 11 elastic resume) — but detection used to end at a JSONL
+row: a silent-data-corruption hit or a sustained loss spike either
+poisoned the trajectory or needed a human to kill the job. Production
+TPU training closes this loop automatically (the operational posture of
+the pjit/TPUv4 experience reports, PAPERS.md): roll back to a
+known-good checkpoint, skip the offending data window, and continue.
+``fit(repair=...)`` wires this module in (docs/MULTIHOST.md "Recovering
+from loss spikes and SDCs"); every action books honestly as a one-shot
+telemetry ``repair`` row, the report's ``repairs`` history, and the
+goodput ``repair_s``/``repair_replay_s`` components.
+
+**Triggers** (the controller subscribes to the telemetry event bus and
+to the per-step health metrics):
+
+- ``sdc_divergence`` — the replica-divergence probe's verdict (a single
+  flipped bit in one replica's params; ``divergence_every`` must be on
+  for this trigger to exist);
+- ``skip_streak`` — ``skip_streak`` CONSECUTIVE non-finite/skipped
+  steps: one poisoned step is ``guard_nonfinite``'s job (skip the
+  update, move on); a streak means the poison is in the data window or
+  the state, and skipping updates forever is not training;
+- ``loss_spike`` — ``spike_patience`` NanSentry spike verdicts within
+  ``spike_window_steps`` (one spike is news; a sustained spike is
+  divergence that will not heal).
+
+**The escalation ladder** (executed in-process by ``fit()``):
+
+1. **rollback**: restore the last-known-good ANCHOR checkpoint (below),
+   re-zero the quantized reducer's error-feedback residual, and reset
+   the delayed-fetch/double-buffer pipelines — the same resets
+   ``elastic.py`` performs on a world resize;
+2. **skip**: advance the data cursor ``skip_window`` batches PAST the
+   trigger (the offending window is never replayed) and fold a
+   repair-generation salt into the step RNG so dropout masks and
+   stochastic-rounding draws redraw — a spike caused by one unlucky
+   draw, not data, heals on the redraw alone;
+3. **restart (exit 77)**: a REPEAT trigger inside the window just
+   repaired means in-process state (or this host) may itself be sick —
+   persist the rollback-and-skip directive (``tpudist_repair.json``
+   next to the checkpoints), exit :data:`~tpudist.resilience.exitcodes
+   .EXIT_REPAIR`, and let the supervisor's existing backoff/budget
+   machinery relaunch; bring-up consumes the directive (restore the
+   anchor, skip FURTHER);
+4. **circuit-break**: a rolling budget (``max_repairs`` per
+   ``budget_window_s``) turns a deterministically-poisoned run into
+   :class:`RepairExhausted` — a non-restartable, non-zero exit — instead
+   of a rollback loop that burns the fleet forever.
+
+**Last-known-good anchoring**: "newest save" is NOT "known good" — a
+checkpoint written while a spike was incubating is exactly the state a
+rollback must avoid. A save becomes a *candidate*; only after
+``anchor_clean_steps`` subsequent steps with clean health metrics is it
+PROMOTED to the anchor (``Checkpointer.write_anchor`` — exempt from
+``keep_last`` pruning); any unhealthy step, or any trigger, DEMOTES all
+pending candidates. For SDC triggers the promotion lag must exceed the
+probe's detection latency: choose ``anchor_clean_steps`` > 2 ×
+``divergence_every`` or a poisoned save can promote before the delayed
+probe verdict lands (the defaults respect this for the drill configs;
+docs/MULTIHOST.md spells out the production numbers).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+from tpudist.resilience.exitcodes import EXIT_REPAIR
+
+__all__ = [
+    "RepairPolicy",
+    "RepairAction",
+    "RepairController",
+    "RepairRestart",
+    "RepairExhausted",
+    "resolve_policy",
+]
+
+#: the durable repair record, next to the checkpoints: the applied-repair
+#: history (the budget's evidence across generations) plus the pending
+#: rollback-and-skip directive an exit-77 restart leaves for the next
+#: generation's bring-up
+STATE_FILE = "tpudist_repair.json"
+
+
+class RepairRestart(SystemExit):
+    """Rung 3 of the ladder: a repeat trigger inside the just-repaired
+    window — the directive is durable, the process asks for a fresh
+    start. A :class:`SystemExit` carrying ``code == EXIT_REPAIR`` (77),
+    the restartable code the supervisor relaunches promptly; ``main.py``
+    and the example trainers need no handler. ``action`` carries the
+    persisted directive for library callers."""
+
+    def __init__(self, action: "RepairAction | None" = None,
+                 step: int | None = None):
+        super().__init__(EXIT_REPAIR)
+        self.action = action
+        self.step = step
+
+    def __str__(self) -> str:
+        where = f" at step {self.step}" if self.step is not None else ""
+        return (
+            f"repair loop hit a repeat trigger{where}; rollback-and-skip "
+            f"directive persisted, exiting {EXIT_REPAIR} for a supervised "
+            "relaunch"
+        )
+
+
+class RepairExhausted(RuntimeError):
+    """Rung 4: the rolling repair budget is spent — the poison is
+    deterministic (or the hardware is dying) and further rollbacks would
+    spin forever. Propagates through fit's real crash path: report
+    written, non-restartable non-zero exit, the supervisor's crash
+    budget (not its restartable fast path) decides what happens next."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RepairPolicy:
+    """Knobs of the repair loop — ``fit(repair=True)`` runs the
+    defaults; pass a policy (or a dict of overrides) to tune.
+
+    ``skip_window``: batches skipped past the trigger step on rollback —
+    the data window presumed offending. ``anchor_clean_steps``: clean
+    health steps a save must outlive before promotion to the rollback
+    anchor (keep it above 2x the divergence-probe cadence when SDC
+    triggers matter — see the module doc). ``skip_streak``: consecutive
+    non-finite/skipped steps that trigger a repair (1 poisoned step is
+    the in-graph guard's job). ``spike_patience`` NanSentry spike
+    verdicts within ``spike_window_steps`` trigger on sustained spikes.
+    ``repeat_window``: a new trigger within this many steps of the
+    previous repair's resume point means the repair DIDN'T TAKE (same
+    incident, not a new one) and the ladder escalates to a restart —
+    keep it above the slowest detector's latency (2 x
+    ``divergence_every`` for the probe: detection of a re-poisoned
+    state lands that many steps after the resume). ``max_repairs`` per
+    rolling ``budget_window_s`` is the circuit breaker (0 disables —
+    never circuit-break). ``salt_stride`` spaces the repair-generation
+    RNG salts folded into the step's dropout/stochastic-rounding
+    seed."""
+
+    skip_window: int = 8
+    anchor_clean_steps: int = 16
+    skip_streak: int = 3
+    spike_patience: int = 2
+    spike_window_steps: int = 64
+    repeat_window: int = 16
+    max_repairs: int = 3
+    budget_window_s: float = 3600.0
+    salt_stride: int = 1_000_003
+
+    def salted_seed(self, seed: int, salt: int) -> int:
+        """The step-RNG seed for repair generation ``salt`` (0 = the
+        pristine run: exactly ``seed``, so a never-repaired run's
+        programs are bit-identical to a repair-less one)."""
+        return int(seed) + self.salt_stride * int(salt)
+
+
+def resolve_policy(repair) -> RepairPolicy | None:
+    """``fit(repair=...)``'s coercion point: ``None``/``False`` → off,
+    ``True`` → defaults, a dict → overrides, a policy → itself."""
+    if repair is None or repair is False:
+        return None
+    if repair is True:
+        return RepairPolicy()
+    if isinstance(repair, RepairPolicy):
+        return repair
+    if isinstance(repair, Mapping):
+        return RepairPolicy(**dict(repair))
+    raise ValueError(
+        f"repair={repair!r}: expected None/False/True/RepairPolicy/"
+        "dict of RepairPolicy overrides"
+    )
+
+
+@dataclasses.dataclass
+class RepairAction:
+    """One planned rung of the ladder (``RepairController.plan``)."""
+
+    kind: str  # "rollback" | "restart"
+    cause: dict
+    rollback_step: int
+    anchored: bool
+    skip_from: int
+    skip_to: int
+    salt: int
+    discarded_steps: int
+    replay_s: float
+    generation: int
+    t: float
+
+    def row(self) -> dict:
+        """The telemetry ``repair`` row / history entry — one honest
+        record per action: cause, rollback target, skipped window, what
+        was done."""
+        return {
+            "action": self.kind,
+            "cause": dict(self.cause),
+            "rollback_step": int(self.rollback_step),
+            "anchored": bool(self.anchored),
+            "skip_from": int(self.skip_from),
+            "skip_to": int(self.skip_to),
+            "salt": int(self.salt),
+            "discarded_steps": int(self.discarded_steps),
+            "replay_s": round(float(self.replay_s), 6),
+            "generation": int(self.generation),
+            "t": round(float(self.t), 3),
+        }
+
+
+class RepairController:
+    """The policy engine ``fit()`` drives: detector subscriptions in,
+    planned ladder actions out, with the anchor promotion arithmetic and
+    the durable cross-generation record in between.
+
+    Every rank constructs one; decisions are deterministic functions of
+    replicated per-step scalars and the shared state file, so ranks act
+    in lockstep — only rank 0 writes the file (``write_state``), the
+    same discipline as the geometry meta.
+    """
+
+    #: bound on the per-step interval map that prices a rollback's
+    #: discarded work — covers any plausible anchor-to-trigger span
+    CUM_CAP = 8192
+
+    def __init__(self, policy: RepairPolicy, checkpoint_dir, *,
+                 generation: int = 0, clock=time.time):
+        self.policy = policy
+        self.directory = Path(checkpoint_dir)
+        self.generation = int(generation)
+        self._clock = clock
+        self._ckpt = None  # bound by fit once the Checkpointer exists
+        self.history: list[dict] = []
+        self.pending: dict | None = None
+        self._load()
+        # last-known-good anchoring
+        self.anchored: int | None = None
+        self._candidates: list[int] = []
+        # trigger state
+        self._trigger: dict | None = None
+        self._skip_streak = 0
+        self._spikes: collections.deque[int] = collections.deque()
+        # replay pricing: cumulative step-interval sums by step number
+        self._cum: collections.OrderedDict[int, float] = (
+            collections.OrderedDict()
+        )
+        self._cum_total = 0.0
+
+    # -- durable record ----------------------------------------------------
+
+    def _state_path(self) -> Path:
+        return self.directory / STATE_FILE
+
+    def _load(self) -> None:
+        p = self._state_path()
+        if not p.exists():
+            return
+        try:
+            blob = json.loads(p.read_text())
+            self.history = [e for e in blob.get("history", [])
+                            if isinstance(e, dict)]
+            pend = blob.get("pending")
+            self.pending = pend if isinstance(pend, dict) else None
+        except (ValueError, OSError):
+            # a torn file must not kill bring-up; the atomic writer makes
+            # this near-impossible, but accounting is never a crash source
+            self.history, self.pending = [], None
+
+    def write_state(self) -> None:
+        import jax
+
+        from tpudist.checkpoint import atomic_write_json
+
+        if jax.process_index() == 0:
+            self.directory.mkdir(parents=True, exist_ok=True)
+            atomic_write_json(
+                self.directory, STATE_FILE,
+                {"v": 1, "history": self.history, "pending": self.pending},
+            )
+
+    def bind(self, ckpt) -> "RepairController":
+        """Attach the run's :class:`tpudist.checkpoint.Checkpointer`
+        (anchor persistence + rollback-target enumeration), and wire the
+        retention protect hook: anchor CANDIDATES must survive
+        ``keep_last`` pruning until they promote or demote, or the
+        promoted anchor would name a deleted step dir. Chained like the
+        chaos injector's ``bind``."""
+        self._ckpt = ckpt
+        self.anchored = ckpt.read_anchor()
+        ckpt.protect_steps = self.protected_steps
+        return self
+
+    def protected_steps(self) -> set[int]:
+        """Steps retention must not delete: the anchor plus every
+        pending candidate (a save inside its clean-step promotion
+        window)."""
+        out = set(self._candidates)
+        if self.anchored is not None:
+            out.add(int(self.anchored))
+        return out
+
+    @property
+    def salt(self) -> int:
+        """The repair-generation RNG salt the CURRENT trajectory runs
+        under: the last applied action's salt (0 on a never-repaired
+        run). Persisted through the history so a post-repair trajectory
+        keeps its redraw across ordinary preempt/resume cycles."""
+        if self.history:
+            return int(self.history[-1].get("salt", 0) or 0)
+        return 0
+
+    def consume_pending(self) -> dict | None:
+        """Bring-up applied the exit-77 directive (anchor restored,
+        cursor advanced): clear it durably. Returns the directive."""
+        directive, self.pending = self.pending, None
+        if directive is not None:
+            self.write_state()
+        return directive
+
+    # -- anchoring ---------------------------------------------------------
+
+    def on_save(self, step: int) -> None:
+        """A checkpoint landed: it becomes an anchor CANDIDATE — promoted
+        only after ``anchor_clean_steps`` clean steps, demoted by any
+        unhealthy step or trigger in between."""
+        step = int(step)
+        if step not in self._candidates:
+            self._candidates.append(step)
+
+    def _promote(self, at_step: int) -> None:
+        ripe = [c for c in self._candidates
+                if at_step - c >= self.policy.anchor_clean_steps]
+        if not ripe:
+            return
+        new_anchor = max(ripe)
+        self._candidates = [c for c in self._candidates if c > new_anchor]
+        if self.anchored is None or new_anchor > self.anchored:
+            self.anchored = new_anchor
+            if self._ckpt is not None:
+                self._ckpt.write_anchor(new_anchor)
+
+    def _demote(self) -> None:
+        # a save taken while the incident was incubating must never
+        # become the rollback target
+        self._candidates.clear()
+
+    # -- detection ---------------------------------------------------------
+
+    def observe_step(self, step: int, metrics: Mapping[str, Any],
+                     interval_s: float = 0.0) -> None:
+        """One resolved step's host-side scalars (fit's delayed
+        pipeline): drives the skip-streak arithmetic, the anchor
+        promotion clock, and the replay-pricing bookkeeping."""
+        import math
+
+        step = int(step)
+        self._cum_total += max(float(interval_s), 0.0)
+        self._cum[step] = self._cum_total
+        while len(self._cum) > self.CUM_CAP:
+            self._cum.popitem(last=False)
+        loss = metrics.get("loss")
+        try:
+            finite = loss is not None and math.isfinite(float(loss))
+        except (TypeError, ValueError):
+            finite = False
+        healthy = (
+            finite
+            and not int(metrics.get("update_skipped", 0) or 0)
+            and not int(metrics.get("nonfinite_grad_count", 0) or 0)
+        )
+        if healthy:
+            self._skip_streak = 0
+            self._promote(step)
+        else:
+            self._skip_streak += 1
+            self._demote()
+            if self._skip_streak >= self.policy.skip_streak:
+                self._set_trigger({
+                    "cause": "skip_streak",
+                    "detector": "guard_nonfinite",
+                    "step": step,
+                    "streak": self._skip_streak,
+                })
+
+    def on_detection(self, ev: Mapping[str, Any]) -> None:
+        """Telemetry event-bus listener (``Telemetry.add_listener``):
+        divergence verdicts trigger immediately (an SDC has no benign
+        reading); sentry spike verdicts accumulate toward the
+        sustained-spike rule; sentry ``nonfinite`` events are left to
+        the skip-streak arithmetic (a single non-finite step is the
+        guard's job, and the streak sees every step, not just the
+        cooldown-surviving events)."""
+        det = ev.get("detector")
+        if det == "divergence":
+            self._set_trigger({
+                "cause": "sdc_divergence",
+                "detector": "divergence",
+                "step": int(ev.get("step", -1)),
+                "replica_divergence": ev.get("replica_divergence"),
+                "state_nonfinite": ev.get("state_nonfinite"),
+            })
+        elif det == "sentry" and ev.get("event") == "loss_spike":
+            step = int(ev.get("step", -1))
+            self._spikes.append(step)
+            while (self._spikes
+                   and self._spikes[0] < step - self.policy.spike_window_steps):
+                self._spikes.popleft()
+            if len(self._spikes) >= self.policy.spike_patience:
+                self._set_trigger({
+                    "cause": "loss_spike",
+                    "detector": "sentry",
+                    "step": step,
+                    "spike_events": len(self._spikes),
+                    "loss": ev.get("loss"),
+                })
+
+    def _set_trigger(self, cause: dict) -> None:
+        self._demote()
+        if self._trigger is None:
+            self._trigger = cause
+
+    @property
+    def triggered(self) -> dict | None:
+        return self._trigger
+
+    def take_trigger(self) -> dict:
+        trigger, self._trigger = self._trigger, None
+        self._skip_streak = 0
+        self._spikes.clear()
+        return trigger
+
+    # -- the ladder --------------------------------------------------------
+
+    def _rollback_target(self) -> tuple[int, bool]:
+        if self.anchored is not None:
+            return int(self.anchored), True
+        # no promotion yet (run too young): the OLDEST surviving save is
+        # the most conservative guess at known-good — recorded as
+        # anchored=False so the row stays honest
+        steps = self._ckpt.all_steps() if self._ckpt is not None else []
+        if not steps:
+            raise RepairExhausted(
+                "repair triggered with no checkpoint to roll back to — "
+                "fit(repair=...) saves an initial checkpoint at bring-up, "
+                "so this means even that save is gone"
+            )
+        return int(steps[0]), False
+
+    def plan(self, trigger: dict, current_step: int, *,
+             max_step: int | None = None) -> RepairAction:
+        """Decide the rung for ``trigger`` observed with ``current_step``
+        the in-flight (to-be-discarded) step. Raises
+        :class:`RepairExhausted` when the rolling budget is spent;
+        otherwise returns a ``rollback`` action — or a ``restart`` when
+        the trigger landed inside the window the previous repair just
+        skipped (same data already skipped, salt already redrawn: the
+        remaining suspects are in-process state and this host, so ask
+        the supervisor for a fresh world). The caller applies the action
+        and then :meth:`record`\\ s it.
+
+        Multi-process caveat: the budget gate compares per-rank wall
+        clocks against ``budget_window_s``, and each rank measures both
+        the entry stamp and ``now`` on its OWN clock — so ranks agree
+        unless an entry's age lands within their microsecond call-skew
+        of EXACTLY the window edge. In that astronomically thin window
+        one rank could raise :class:`RepairExhausted` while its peers
+        enter the rollback's collective restore and block; the hang
+        watchdog (``hang_timeout_s``) is the designed backstop for a
+        rank dying inside a collective, there as here. A truly shared
+        decision would need its own collective per trigger — not worth
+        the cost for a boundary this thin."""
+        now = float(self._clock())
+        if self.policy.max_repairs > 0:
+            recent = [
+                e for e in self.history
+                if now - float(e.get("t", now)) <= self.policy.budget_window_s
+            ]
+            if len(recent) >= self.policy.max_repairs:
+                raise RepairExhausted(
+                    f"repair budget exhausted: {len(recent)} repairs in "
+                    f"the last {self.policy.budget_window_s:.0f}s (max "
+                    f"{self.policy.max_repairs}) and another trigger "
+                    f"({trigger.get('cause')}) at step {current_step} — "
+                    "the poison is deterministic; giving up (see the "
+                    "report's repairs history)"
+                )
+        rollback_step, anchored = self._rollback_target()
+        current_step = int(current_step)
+        skip_to = current_step + self.policy.skip_window
+        if max_step is not None:
+            skip_to = min(skip_to, int(max_step))
+        skip_to = max(skip_to, current_step)
+        last = self.history[-1] if self.history else None
+        # "repeat": the new trigger landed before repeat_window steps of
+        # clean progress past the previous repair's resume point — the
+        # data was already skipped and the salt already redrawn, so the
+        # remaining suspects are in-process state and this host
+        repeat = (
+            last is not None
+            and current_step
+            <= int(last.get("skip_to", -1))
+            + max(self.policy.repeat_window, self.policy.skip_window)
+        )
+        replay = max(
+            self._cum_total - self._cum.get(rollback_step, 0.0), 0.0
+        )
+        return RepairAction(
+            kind="restart" if repeat else "rollback",
+            cause=dict(trigger),
+            rollback_step=rollback_step,
+            anchored=anchored,
+            skip_from=current_step,
+            skip_to=skip_to,
+            salt=self.salt + 1,
+            discarded_steps=max(current_step - rollback_step, 0),
+            replay_s=replay,
+            generation=self.generation,
+            t=now,
+        )
+
+    def record(self, action: RepairAction) -> dict:
+        """Book an applied (or restart-persisted) action durably: it
+        charges the rolling budget, carries the salt forward, and — for
+        ``restart`` — becomes the pending directive the next
+        generation's bring-up consumes."""
+        entry = action.row()
+        self.history.append(entry)
+        if action.kind == "restart":
+            self.pending = entry
+        self.write_state()
+        return entry

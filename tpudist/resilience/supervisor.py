@@ -7,10 +7,10 @@ policy half of the upgraded launcher (the spawn/reap half stays in
 ``tpudist/launch.py``):
 
 - **restartable fast path**: exit codes in :data:`~tpudist.resilience
-  .exitcodes.RESTARTABLE` (75 preempted, 76 watchdog hang) mean the
-  trainer persisted its state and *asked* to be relaunched — they restart
-  promptly regardless of ``--max_restarts``, bounded only by the budget
-  window below.
+  .exitcodes.RESTARTABLE` (75 preempted, 76 watchdog hang, 77
+  repair-restart) mean the trainer persisted its state and *asked* to be
+  relaunched — they restart promptly regardless of ``--max_restarts``,
+  bounded only by the budget window below.
 - **crash path**: any other non-zero exit restarts only while the legacy
   ``max_restarts`` attempt counter allows, with exponential backoff +
   jitter between attempts (a crashing fleet must not hammer the
@@ -38,6 +38,7 @@ import time
 from typing import Callable
 
 from tpudist.resilience.exitcodes import (
+    EXIT_HISTORY_ENV,
     EXIT_INTERRUPT,
     EXIT_OK,
     is_restartable,
@@ -137,6 +138,7 @@ class Supervisor:
         sleep: Callable[[float], None] = time.sleep,
         rng: random.Random | None = None,
         log: Callable[[str], None] | None = None,
+        environ=None,
     ):
         self._run_world = run_world
         self.max_restarts = int(max_restarts)
@@ -149,11 +151,19 @@ class Supervisor:
         self._log = log or (
             lambda m: print(m, file=sys.stderr, flush=True)
         )
+        # the per-generation exit-code record, oldest first — exported to
+        # every RELAUNCHED world as TPUDIST_EXIT_HISTORY so the run
+        # report can reconstruct the incident timeline in one file
+        import os
+
+        self._environ = os.environ if environ is None else environ
+        self.exit_history: list[int] = []
 
     def run(self) -> int:
         crash_attempt = 0
         while True:
             rc = self._run_world(self.generation)
+            self.exit_history.append(int(rc))
             kind = classify(rc)
             if kind in ("ok", "stop") or self._stop():
                 return rc
@@ -195,3 +205,9 @@ class Supervisor:
                 # must win over the pending restart
                 return rc
             self.generation += 1
+            # export the record BEFORE the relaunch: _run_world copies
+            # the environment into each child, so the next generation's
+            # run report sees every predecessor's exit code
+            self._environ[EXIT_HISTORY_ENV] = ",".join(
+                str(c) for c in self.exit_history
+            )

@@ -174,7 +174,9 @@ class TelemetrySink:
     ``checkpoint_fallback``), ``reshard`` (one-time elastic-resume record:
     cross-world-size ZeRO-1 relayout, residual flush, cursor remap),
     ``compile_cache`` (one-time AOT executable-cache outcome:
-    hit/miss/bytes/load_s). The serving engine
+    hit/miss/bytes/load_s), ``repair`` (one record per executed repair
+    action — cause, rollback step, skipped window, action taken:
+    ``tpudist.resilience.repair``). The serving engine
     (``tpudist.serve``) writes ``serve``/``serve_summary`` SLO rows
     through the same sink. Schema glossary in docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
     APPEND mode — both halves of the flight-recorder contract: the anomaly
@@ -383,6 +385,16 @@ class NanSentry:
             self._window.append(loss)
         return None
 
+    def reset(self) -> None:
+        """Forget the baseline window and cooldown — the repair loop's
+        rollback rewound the trajectory, so losses observed on the
+        discarded (possibly poisoned) span must not seed the spike
+        baseline of the repaired one, and a live cooldown must not
+        silence a fresh post-repair incident. Event history is kept (it
+        is the report's evidence)."""
+        self._window.clear()
+        self._quiet_until = -1
+
 
 class TimedIterator:
     """Wrap a batch iterator and record the wall seconds the consumer spent
@@ -463,6 +475,17 @@ class Telemetry:
         # by build_telemetry when any health knob (or the run report) is
         # on; None keeps every health path a no-op
         self.health = None
+        # detector event bus: every sentry/divergence VERDICT is published
+        # to these callbacks (the repair controller subscribes) — the
+        # detectors stay pure observers, the subscribers decide what a
+        # verdict is worth
+        self._listeners: list = []
+        # executed-repair record (tpudist.resilience.repair): this
+        # generation's rows via set_repair; repair_history, when fit
+        # attaches the controller's live cross-generation list, is what
+        # the report's `repairs` section prefers
+        self.repair_events: list[dict] = []
+        self.repair_history: list[dict] | None = None
         # goodput tracker (tpudist.resilience.goodput), attached by fit();
         # the run report's `goodput` section reads it. None = no section.
         self.goodput = None
@@ -488,6 +511,39 @@ class Telemetry:
             self.process_index = int(rank)
 
     # -- wiring ------------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to detector verdicts: ``fn(event)`` is called with
+        every sentry anomaly (``{"detector": "sentry", "event":
+        "nonfinite"|"loss_spike", ...}``) and every divergence-probe
+        verdict (``{"detector": "divergence", ...}``) as they resolve.
+        Exceptions propagate — a subscriber is run logic, not logging."""
+        self._listeners.append(fn)
+
+    def _publish(self, event: Mapping[str, Any]) -> None:
+        for fn in list(self._listeners):
+            fn(event)
+
+    def set_repair(self, info: Mapping[str, Any]) -> None:
+        """One ``repair`` row per executed repair action
+        (``tpudist.resilience.repair``): cause, rollback step, skipped
+        window, action taken. Every rank records the event (the report's
+        history source); rank 0 writes the row."""
+        info = dict(info)
+        self.repair_events.append(info)
+        if self.rank == 0:
+            self.sink.write("repair", info.get("skip_from"), **info)
+
+    def reset_for_repair(self) -> None:
+        """The repair loop just rolled the trajectory back: clear the
+        sentry's spike baseline/cooldown and drop the health layer's
+        in-flight delayed fetches — a pending divergence probe or
+        aggregation gather describes the DISCARDED state and must not
+        re-trigger (or mis-describe) the repaired trajectory."""
+        if self.sentry is not None:
+            self.sentry.reset()
+        if self.health is not None:
+            self.health.reset_pipelines()
 
     def set_fusion(self, info: Mapping[str, Any]) -> None:
         """One-time ``fusion`` row (rank 0): the step-fusion layer's
@@ -706,6 +762,9 @@ class Telemetry:
                     "anomaly", step, epoch=epoch, profiler_armed=armed,
                     **{k: v for k, v in event.items() if k != "step"},
                 )
+                # detector → event bus: the repair loop (and any other
+                # subscriber) acts on the verdict the row records
+                self._publish({"detector": "sentry", **event})
 
         if self.heartbeat_every and step % self.heartbeat_every == 0:
             # every process writes its own heartbeat — the cross-host

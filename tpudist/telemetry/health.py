@@ -442,7 +442,7 @@ class RunHealth:
         self.probe = (
             DivergenceProbe(
                 sink, mesh, every=config.divergence_every, rank=rank,
-                on_event=self._arm_recorder,
+                on_event=self._on_divergence,
             )
             if config.divergence_every and mesh is not None else None
         )
@@ -506,6 +506,26 @@ class RunHealth:
         ):
             return False
         return bool(self.profiler.arm(self.config.capture_steps))
+
+    def _on_divergence(self, event: dict) -> bool:
+        """The probe's verdict: arm the flight recorder (the row records
+        whether that succeeded) AND publish onto the telemetry event bus
+        — the repair loop's SDC trigger subscribes there."""
+        armed = self._arm_recorder(event)
+        if self._tel is not None:
+            self._tel._publish({"detector": "divergence", **event})
+        return armed
+
+    def reset_pipelines(self) -> None:
+        """Drop in-flight delayed fetches (pending aggregation gather /
+        divergence probe) WITHOUT resolving them — the repair loop's
+        rollback made their dispatched-on state history; resolving a
+        probe of the discarded state would re-trigger the very incident
+        the repair just cleared."""
+        if self.aggregator is not None:
+            self.aggregator._pending = None
+        if self.probe is not None:
+            self.probe._pending = None
 
     def _on_trip(self, trip: dict) -> None:
         # runs on the watchdog thread while the main thread is (by
@@ -652,6 +672,19 @@ class RunHealth:
         report["goodput"] = (
             goodput.summary(exit_reason) if goodput is not None else None
         )
+        # self-healing record (tpudist.resilience.repair), appended after
+        # the existing keys like every resilience field: the controller's
+        # durable CROSS-GENERATION history when fit attached it, else
+        # this generation's repair rows; plus the supervisor's
+        # per-generation exit codes (TPUDIST_EXIT_HISTORY) — one file
+        # reconstructs the full incident timeline across the job's lives
+        repair_history = getattr(tel, "repair_history", None)
+        if repair_history is None:
+            repair_history = getattr(tel, "repair_events", []) or []
+        report["repairs"] = list(repair_history)
+        from tpudist.resilience.exitcodes import exit_history
+
+        report["supervisor_exit_history"] = exit_history()
         report = _strict_json(report)
         self.report_path.write_text(json.dumps(report, indent=1))
         return report

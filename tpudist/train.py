@@ -759,10 +759,12 @@ def fit(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
     checkpoint_every_s: float | None = None,
+    keep_last: int | None = None,
     resume: bool = True,
     elastic: bool = False,
     compile_cache: str | None = None,
     preempt: bool | str = "auto",
+    repair=None,
     chaos=None,
     init_params=None,
     init_input=None,
@@ -836,9 +838,46 @@ def fit(
     recovery testing (``tpudist.resilience.chaos``): a spec string like
     ``"sigterm@12"`` / ``"crash@5@*"`` / ``"hang:600@8"`` /
     ``"corrupt@12"`` (truncate the newest checkpoint, then crash — the
-    die-mid-write drill the fallback restore absorbs), a ``ChaosSpec``,
-    or a prebuilt ``ChaosInjector``. ``None`` (default) injects
-    nothing.
+    die-mid-write drill the fallback restore absorbs) /
+    ``"bitflip@12"`` (flip one mantissa bit in one data-replica's param
+    copy — the SDC drill the divergence probe + repair loop absorb) /
+    ``"nanburst:3@12"`` (poison three consecutive steps' batches with
+    NaNs, defeating the single-step guard), a comma-separated
+    composition of several specs, a ``ChaosSpec`` (or list), or a
+    prebuilt ``ChaosInjector``. ``None`` (default) injects nothing.
+
+    ``repair`` (``None``/``False`` off; ``True`` = default
+    :class:`tpudist.resilience.repair.RepairPolicy`; a policy or a dict
+    of overrides to tune) turns detector verdicts into the self-healing
+    escalation ladder (docs/MULTIHOST.md "Recovering from loss spikes
+    and SDCs"): on a replica-divergence verdict, a ``skip_streak`` of
+    consecutive guard-skipped steps, or a sustained NanSentry spike, fit
+    rolls state back to the last-known-good ANCHORED checkpoint (a save
+    promoted only after ``anchor_clean_steps`` clean health steps),
+    advances the data cursor ``skip_window`` batches past the trigger,
+    folds a repair-generation salt into the step RNG so dropout/
+    stochastic-rounding redraw, and continues — in-process, no
+    supervisor involved. A repeat trigger inside the just-repaired
+    window persists a rollback-and-skip directive and raises
+    :class:`tpudist.resilience.RepairRestart` (SystemExit 77, the
+    restartable code the supervisor relaunches; bring-up consumes the
+    directive); a rolling ``max_repairs``/``budget_window_s`` budget
+    circuit-breaks a deterministic poison with
+    :class:`tpudist.resilience.RepairExhausted` instead of spinning.
+    Requires ``checkpoint_dir`` plus a save cadence; implies
+    ``telemetry=True`` when telemetry is off (the detectors live
+    there), and an SDC trigger additionally needs
+    ``divergence_every``. Every action books honestly: a ``repair``
+    JSONL row, the report's ``repairs`` history, and the goodput
+    ``repair_s``/``repair_replay_s`` components.
+
+    ``keep_last`` bounds checkpoint retention to the newest N step dirs
+    (``Checkpointer.keep_last``) so long runs with a tight save cadence
+    stop accumulating unbounded step dirs — the health-ANCHORED step is
+    exempt from pruning (it is the repair loop's rollback target).
+    ``None`` keeps the legacy orbax ``max_to_keep=3`` behavior, except
+    under ``repair`` where anchor-protecting retention is forced
+    (``keep_last=3``).
 
     ``telemetry`` (False | True | ``tpudist.telemetry.TelemetryConfig``)
     turns on the observability subsystem (docs/OBSERVABILITY.md): in-step
@@ -954,6 +993,45 @@ def fit(
     from tpudist.distributed import verify_replicas
 
     verify_replicas(state.params)
+    from tpudist.resilience import (
+        GoodputTracker,
+        Preempted,
+        PreemptionGuard,
+        make_injector,
+        restart_generation,
+    )
+    from tpudist.resilience import repair as repair_mod
+
+    generation = restart_generation()
+    repair_policy = repair_mod.resolve_policy(repair)
+    repair_ctl = None
+    if repair_policy is not None:
+        if checkpoint_dir is None:
+            raise ValueError(
+                "fit(repair=...) needs checkpoint_dir: the escalation "
+                "ladder's first rung is a rollback to the last-known-good "
+                "checkpoint (docs/MULTIHOST.md)"
+            )
+        if not checkpoint_every and not checkpoint_every_s:
+            raise ValueError(
+                "fit(repair=...) needs a save cadence (checkpoint_every "
+                "and/or checkpoint_every_s): without periodic saves the "
+                "rollback target never advances past bring-up"
+            )
+        if keep_last is None:
+            # anchor-protecting retention: orbax's newest-N policy would
+            # prune the rollback target out from under the repair loop
+            keep_last = 3
+        # built BEFORE the step so the directive's RNG salt (and the
+        # repair-generation salt of a resumed post-repair trajectory)
+        # reaches the compiled program's dropout/SR streams
+        repair_ctl = repair_mod.RepairController(
+            repair_policy, checkpoint_dir, generation=generation
+        )
+        if not telemetry:
+            # the triggers ARE telemetry verdicts; a repair request with
+            # telemetry off would watch nothing
+            telemetry = True
     tel_cfg = None
     if telemetry:
         from tpudist.telemetry import TelemetryConfig
@@ -962,18 +1040,27 @@ def fit(
             telemetry if isinstance(telemetry, TelemetryConfig)
             else TelemetryConfig()
         )
-    step = make_train_step(
-        model, tx, mesh,
-        loss_fn=loss_fn, input_key=input_key, label_key=label_key,
-        grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
-        forward_loss=forward_loss, dropout_seed=seed,
-        input_transform=input_transform, reduce=reduce, fused=fused,
-        **(tel_cfg.step_kwargs() if tel_cfg else {}),
-        # keep whatever sharding create_train_state produced (replicated for
-        # plain DP, sharded for TP-annotated models) — forcing replicated
-        # here would all-gather a TP model's params on the first step
-        state_sharding=state_shardings_of(state),
+
+    def build_step(step_seed):
+        return make_train_step(
+            model, tx, mesh,
+            loss_fn=loss_fn, input_key=input_key, label_key=label_key,
+            grad_accum=grad_accum, remat=remat, batch_spec=batch_spec,
+            forward_loss=forward_loss, dropout_seed=step_seed,
+            input_transform=input_transform, reduce=reduce, fused=fused,
+            **(tel_cfg.step_kwargs() if tel_cfg else {}),
+            # keep whatever sharding create_train_state produced
+            # (replicated for plain DP, sharded for TP-annotated models)
+            # — forcing replicated here would all-gather a TP model's
+            # params on the first step
+            state_sharding=state_shardings_of(state),
+        )
+
+    eff_seed = (
+        repair_policy.salted_seed(seed, repair_ctl.salt)
+        if repair_ctl is not None else seed
     )
+    step = build_step(eff_seed)
     if step.grad_reducer is not None:
         # error-feedback residual born sharded over the data replicas
         # (no-op for methods that carry none)
@@ -1013,16 +1100,7 @@ def fit(
         # otherwise slip past the geometry guard and die in orbax with a
         # bare shape mismatch instead of a validated reshard/refusal
         run_meta["data_world"] = int(mesh.shape[mesh_lib.DATA_AXIS])
-    from tpudist.resilience import (
-        GoodputTracker,
-        Preempted,
-        PreemptionGuard,
-        make_injector,
-        restart_generation,
-    )
-
     chaos_inj = make_injector(chaos)
-    generation = restart_generation()
     # goodput spans only surface through the run report, so the tracker
     # rides the telemetry switch; its per-boundary cost is two clock reads
     gp = GoodputTracker(generation=generation) if tel_cfg is not None else None
@@ -1033,6 +1111,7 @@ def fit(
     # this line exits 75 after persisting whatever had become restorable.
     guard = PreemptionGuard(enabled=bool(preempt)).__enter__()
     preempt_signum = None
+    repair_exit = None  # the ladder's rung-3 action, raised as exit 77
     ckpt = None
     start_step = 0
     losses: list[float] = []
@@ -1100,7 +1179,9 @@ def fit(
                         ),
                         "input_key": input_key,
                         "label_key": label_key,
-                        "dropout_seed": seed,
+                        # the SALTED seed: a post-repair trajectory's
+                        # program differs exactly when its RNG streams do
+                        "dropout_seed": eff_seed,
                         "model": model_id,
                     },
                 )
@@ -1117,12 +1198,45 @@ def fit(
 
             # inside try/finally so the manager's async-checkpointing threads
             # are torn down even when bring-up below raises
-            ckpt = Checkpointer(checkpoint_dir)
+            ckpt = Checkpointer(checkpoint_dir, keep_last=keep_last)
             if chaos_inj is not None:
                 # the corrupt@step drill truncates the newest checkpoint:
                 # bind the target and the settle hook so it corrupts a
                 # deterministic, already-committed step
                 chaos_inj.bind(checkpoint_dir, wait=ckpt.wait)
+            if repair_ctl is not None:
+                # anchor persistence + rollback-target enumeration +
+                # the retention protect hook (candidates must outlive
+                # keep_last pruning until they promote or demote)
+                repair_ctl.bind(ckpt)
+
+                def apply_rollback(state, rollback_step, skip_to, *,
+                                   on_event=None):
+                    """The ONE rollback-apply — the exit-77 bring-up
+                    directive and the in-process ladder share it: settle
+                    async saves, restore the target step, flush the
+                    reducer's error-feedback banks (trajectory state —
+                    the same reset elastic.py performs), set aside newer
+                    (suspect) saves so a crash right after resumes from
+                    the anchor, and jump the data cursor past the
+                    skipped window (state.step IS the cursor, so resume
+                    math and later checkpoints stay consistent)."""
+                    rollback_step = int(rollback_step)
+                    ckpt.wait()
+                    state = ckpt.restore(
+                        like=state, step=rollback_step, on_event=on_event
+                    )
+                    if step.grad_reducer is not None:
+                        state = step.grad_reducer.attach_residual(state)
+                    for s in ckpt.all_steps():
+                        if s > rollback_step:
+                            ckpt.quarantine_failed_step(s)
+                    return state.replace(
+                        step=jax.device_put(
+                            jnp.asarray(int(skip_to), state.step.dtype),
+                            state.step.sharding,
+                        )
+                    )
             # finish or roll back an elastic commit a previous life
             # crashed mid-way: adopt the committed new-world save (its
             # marker meta becomes THE meta — without this, a crash
@@ -1132,6 +1246,9 @@ def fit(
             ckpt.recover_interrupted_reshard()
             resharded = False
             did_restore = False
+            repair_directive = (
+                repair_ctl.pending if repair_ctl is not None else None
+            )
             if ckpt.latest_step() is not None:
                 if not resume:
                     raise ValueError(
@@ -1165,16 +1282,41 @@ def fit(
                             f"checkpoint_dir{hint}"
                         )
                     resharded = True
+                if repair_directive is not None and resharded:
+                    raise ValueError(
+                        "a pending repair directive (exit-77 rollback-and-"
+                        "skip) cannot compose with an elastic world resize "
+                        "in the same bring-up — resume on the original "
+                        "world first, or clear tpudist_repair.json"
+                    )
                 t_restore = time.perf_counter()
-                state = ckpt.restore(
-                    like=state, reshard=resharded, run_meta=run_meta,
-                    mesh=mesh, fallback=True,
-                    on_event=bringup_events.append,
-                )
+                if repair_directive is not None:
+                    # exit-77 relaunch: rung 3 of the repair ladder left a
+                    # rollback-and-skip directive — restore the ANCHORED
+                    # step, not the (suspect) newest, and apply the skip
+                    state = apply_rollback(
+                        state, repair_directive["rollback_step"],
+                        repair_directive["skip_to"],
+                        on_event=bringup_events.append,
+                    )
+                else:
+                    state = ckpt.restore(
+                        like=state, reshard=resharded, run_meta=run_meta,
+                        mesh=mesh, fallback=True,
+                        on_event=bringup_events.append,
+                    )
                 if gp is not None:
                     gp.add("restore_s", time.perf_counter() - t_restore)
                 did_restore = True
-                start_step = int(state.step)
+                if repair_directive is not None:
+                    start_step = int(repair_directive["skip_to"])
+                    repair_ctl.consume_pending()
+                    resume_row = dict(repair_directive)
+                    resume_row["action"] = "resume"
+                    resume_row["resumed_generation"] = generation
+                    bringup_events.append({"tag": "repair", **resume_row})
+                else:
+                    start_step = int(state.step)
                 for ev in bringup_events:
                     # a step the fallback walked past failed to
                     # deserialize: set it aside (never delete — the
@@ -1201,6 +1343,16 @@ def fit(
                         )
             ckpt.write_meta(run_meta)
             ckpt.purge_quarantined()
+            if repair_ctl is not None and ckpt.latest_step() is None:
+                # a rollback target must exist from step one: a trigger
+                # before the first cadence save would otherwise have
+                # nothing to roll back to. Synchronous — a repairable run
+                # is durable before it trains.
+                t_save = time.perf_counter()
+                ckpt.save(state, wait=True)
+                if gp is not None:
+                    gp.add("checkpoint_s", time.perf_counter() - t_save)
+                repair_ctl.on_save(int(state.step))
 
         if cc is not None:
             from tpudist import compile_cache as cc_mod
@@ -1255,9 +1407,6 @@ def fit(
                     else:
                         gp.add("compile_s", cc_info.get("compile_s", 0.0))
 
-        start_epoch = start_step // steps_per_epoch if steps_per_epoch else 0
-        skip_batches = start_step % steps_per_epoch if steps_per_epoch else 0
-
         # the logger truncates ("w") its TSV on construction, so it must not
         # exist until checkpoint bring-up has succeeded — a refused resume
         # above would otherwise clobber the previous run's metrics
@@ -1284,6 +1433,13 @@ def fit(
             )
             if tel is not None:
                 tel.goodput = gp
+                if repair_ctl is not None:
+                    # detector → event-bus → repair controller: sentry and
+                    # divergence verdicts become triggers; the report's
+                    # `repairs` section reads the controller's live
+                    # cross-generation history
+                    tel.add_listener(repair_ctl.on_detection)
+                    tel.repair_history = repair_ctl.history
                 if tel.health is not None and ckpt is not None:
                     # hang_action="exit" tears the process down from the
                     # watchdog thread: give an in-flight async checkpoint
@@ -1305,6 +1461,8 @@ def fit(
                     tag = ev.pop("tag")
                     if tag == "reshard":
                         tel.set_reshard(ev)
+                    elif tag == "repair":
+                        tel.set_repair(ev)
                     else:
                         tel.warn(tag, **ev)
                 if cc_info is not None:
@@ -1404,6 +1562,14 @@ def fit(
                         data_wait_s=data_wait_s, dispatch_s=dispatch_s,
                         device_s=device_s,
                     )
+                if repair_ctl is not None:
+                    # skip-streak arithmetic, anchor promotion clock, and
+                    # replay pricing — after tel.on_step, whose sentry/
+                    # divergence publications may already have set a
+                    # trigger this same resolve
+                    repair_ctl.observe_step(
+                        g, host, interval_s=now - pstart
+                    )
 
             # a SIGTERM that lands while the consumer is BLOCKED on a
             # stalled input pipeline must still reach the graceful path:
@@ -1414,6 +1580,19 @@ def fit(
                 (lambda: guard.tripped is not None) if guard.active else None
             )
             try:
+              # the repair loop: one pass per trajectory segment. A
+              # repair trigger breaks out of the epoch loop, the handler
+              # below rolls back / skips / escalates, and the while
+              # re-enters the epoch loop at the repaired cursor. A
+              # repair-less run takes exactly one pass.
+              while True:
+                repair_request = None
+                start_epoch = (
+                    global_step // steps_per_epoch if steps_per_epoch else 0
+                )
+                skip_batches = (
+                    global_step % steps_per_epoch if steps_per_epoch else 0
+                )
                 for e in range(start_epoch, epochs):
                     if guard.tripped is not None:
                         preempt_signum = guard.tripped
@@ -1432,6 +1611,13 @@ def fit(
                         batches = itertools.islice(iter(train_loader), first_idx, None)
                     else:
                         batches = iter(train_loader)
+                    if chaos_inj is not None:
+                        # the nanburst drill poisons batches by STEP
+                        # position — the wrapper maps this epoch's stream
+                        # onto the steps it will train
+                        batches = chaos_inj.wrap_batches(
+                            batches, global_step + 1
+                        )
                     staged = prefetch_to_mesh(
                         batches, mesh,
                         depth=prefetch_depth, stage_fn=step.stage,
@@ -1453,6 +1639,9 @@ def fit(
                         # emergency checkpoint persists
                         if chaos_inj is not None:
                             chaos_inj.maybe_fire(global_step)
+                            state = chaos_inj.maybe_flip(
+                                global_step, state, mesh
+                            )
                         if guard.tripped is not None:
                             preempt_signum = guard.tripped
                             break
@@ -1514,6 +1703,16 @@ def fit(
                                 device_s,
                             ),
                         )
+                        if (repair_ctl is not None
+                                and repair_ctl.triggered is not None):
+                            # a detector verdict became a trigger (set by
+                            # the resolve above or by a probe verdict
+                            # resolved during observe_state): break to the
+                            # repair handler BEFORE the cadence save — the
+                            # current state is suspect and must not become
+                            # a checkpoint
+                            repair_request = repair_ctl.take_trigger()
+                            break
                         if mem_every and global_step % mem_every == 0:
                             logger.log_memory(device_memory_stats())
                         if ckpt is not None and (
@@ -1524,7 +1723,12 @@ def fit(
                                 >= checkpoint_every_s)
                         ):
                             t_save = time.perf_counter()
-                            ckpt.save(state)
+                            if ckpt.save(state):
+                                if repair_ctl is not None:
+                                    # a new anchor CANDIDATE — promoted
+                                    # only after anchor_clean_steps clean
+                                    # steps (tpudist.resilience.repair)
+                                    repair_ctl.on_save(global_step)
                             if gp is not None:
                                 gp.add(
                                     "checkpoint_s",
@@ -1539,8 +1743,79 @@ def fit(
                     # preemption branch instead of reporting "completed"
                     if preempt_signum is None and guard.tripped is not None:
                         preempt_signum = guard.tripped
-                    if preempt_signum is not None:
+                    if preempt_signum is not None or repair_request is not None:
                         break
+                if (repair_request is None and preempt_signum is None
+                        and repair_ctl is not None
+                        and repair_ctl.triggered is not None):
+                    # a verdict resolved on the run's very last iteration:
+                    # still repair (the rollback discards the poisoned
+                    # tail; the clamped skip_to ends the run at the clean
+                    # cursor) rather than report a poisoned "completed"
+                    repair_request = repair_ctl.take_trigger()
+                if repair_request is None or preempt_signum is not None:
+                    break
+                # ---- the repair ladder (tpudist.resilience.repair) ----
+                # the in-flight delayed-fetch step belongs to the
+                # discarded trajectory: drop it before anything else
+                pending = None
+                device_probe = None
+                t_rep = time.perf_counter()
+                total_steps = epochs * steps_per_epoch
+                action = repair_ctl.plan(
+                    repair_request, global_step, max_step=total_steps
+                )  # raises RepairExhausted when the budget is spent
+                if action.kind == "restart":
+                    # rung 3: repeat trigger inside the window just
+                    # repaired — persist the directive and ask the
+                    # supervisor for a fresh process (exit 77). No save
+                    # of the current (suspect) state.
+                    repair_ctl.record(action)
+                    if tel is not None:
+                        tel.set_repair(action.row())
+                    repair_exit = action
+                    break
+                # rungs 1+2: roll back to the last-known-good anchor
+                # and skip the offending window (the shared
+                # apply_rollback: restore, residual flush, suspect-save
+                # quarantine, cursor jump)
+                state = apply_rollback(
+                    state, action.rollback_step, action.skip_to
+                )
+                global_step = action.skip_to
+                # repair-generation salt: rebuild the step so dropout
+                # masks and stochastic-rounding draws REDRAW on the
+                # replayed span — a spike caused by one unlucky draw
+                # heals on the redraw alone. Skipped when no stochastic
+                # consumer exists: the rebuild would retrace for a
+                # bit-identical program.
+                needs_salt = (
+                    float(getattr(model, "dropout", 0.0) or 0.0) > 0
+                    or (step.grad_reducer is not None
+                        and step.grad_reducer.method == "quantized")
+                )
+                if needs_salt:
+                    step = build_step(
+                        repair_policy.salted_seed(seed, action.salt)
+                    )
+                    if step.grad_reducer is not None:
+                        state = step.grad_reducer.attach_residual(state)
+                repair_ctl.record(action)
+                if chaos_inj is not None:
+                    # deterministic-bug drills (@*) re-arm: a bug that
+                    # survives a rollback must keep biting until the
+                    # budget circuit-breaks
+                    chaos_inj.rearm()
+                if tel is not None:
+                    # sentry baseline/cooldown and pending health
+                    # gathers describe the discarded trajectory
+                    tel.reset_for_repair()
+                    tel.set_repair(action.row())
+                if gp is not None:
+                    gp.add_repair(
+                        time.perf_counter() - t_rep, action.replay_s
+                    )
+                last_save_t = time.monotonic()
             except BaseException as crash_exc:
                 # flush the last completed step before the exception leaves:
                 # the loss history and TSV then end at the step that actually
@@ -1580,9 +1855,16 @@ def fit(
                             )
                     if tel is not None:
                         tel.finish(state.opt_state, status="preempted")
+                elif repair_exit is not None:
+                    # rung-3 exit: the directive is durable, the current
+                    # state is suspect — no save; the report records the
+                    # escalation before exit 77
+                    if tel is not None:
+                        tel.finish(state.opt_state, status="repair_restart")
                 elif tel is not None:
                     tel.finish(state.opt_state)
-            if ckpt and preempt_signum is None and global_step > start_step:
+            if (ckpt and preempt_signum is None and repair_exit is None
+                    and global_step > start_step):
                 ckpt.save(state)
     finally:
         # closed here, OUTSIDE the logger's context: the logger's __exit__
@@ -1602,6 +1884,11 @@ def fit(
         # checkpoint-less notebook run keeps its trained state)
         raise Preempted(preempt_signum, global_step,
                         state=state, losses=losses)
+    if repair_exit is not None:
+        # same discipline for the repair ladder's rung 3: directive and
+        # report durable, exit with the restartable repair code (77) so
+        # the supervisor relaunches and bring-up consumes the directive
+        raise repair_mod.RepairRestart(repair_exit, global_step)
     return state, losses
 
 
