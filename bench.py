@@ -104,6 +104,19 @@ Legs
    vs_baseline = (engine / static) / 1.5 — ≥ 1 meets the ≥1.5× bar — and
    the record carries the engine's TTFT/TPOT percentiles and slot
    utilization.
+16c. ``gpt2_124m_paged_serve_tokens_per_sec`` — the paged-KV memory
+   system's perf contract (docs/SERVING.md "Paged memory"): PR 9's
+   long-tail Poisson workload (prompts 16–128 behind a shared 64-token
+   system prompt, budgets 16+Exp(80)≤448) through the engine
+   paged-vs-contiguous at IDENTICAL HBM (the paged pool holds exactly the
+   contiguous pool's bytes; its freed worst-case headroom funds 4× the
+   slots). value = paged useful tokens/s; the record carries the tok/s
+   ratio, the admitted-concurrency ratio (peak live requests), the
+   prefix-cache hit rate, both sides' TTFT/TPOT percentiles, and the
+   cold-vs-warm engine construction time through ``compile_cache=``
+   (the serving warm start). Interleaved runs, medians, compile excluded;
+   vs_baseline = max(tok/s ratio / 1.3, concurrency ratio / 2) — ≥ 1
+   meets the "≥1.3× tok/s OR ≥2× admitted concurrency at equal HBM" bar.
 16. ``gpt2_124m_preempt_recovery_s`` — the resilience layer's recovery
    drill (docs/MULTIHOST.md "Surviving preemption"): a supervised 124M
    run is chaos-SIGTERM'd mid-stream; the trainer writes its synchronous
@@ -1160,6 +1173,183 @@ def bench_serve() -> None:
     )
 
 
+def bench_paged_serve() -> None:
+    """Paged KV vs contiguous KV at IDENTICAL HBM under PR 9's long-tail
+    Poisson workload (docs/SERVING.md "Paged memory", PERF §7c): GPT-2
+    124M bf16, 32 requests, prompts 16–128 prepended with a SHARED
+    64-token system prompt (what the prefix cache exists for), budgets
+    16 + Exp(80) clipped to 448.
+
+    Both sides get the same bytes: the contiguous engine's 8 slots
+    reserve 8 × 1024 cache rows; the paged engine's pool is exactly those
+    rows cut into 32-token blocks (+1 garbage block), with max_slots
+    raised to 32 — the worst-case headroom the contiguous layout wastes
+    on the tail (median budget ~71 of 448 reserved) funds 4× the
+    concurrent requests, and block-budget admission + preempt-to-queue
+    keep it safe when the tail does materialize. A/B methodology:
+    interleaved runs (contiguous, paged, contiguous, paged, ...), median
+    wall per side, compile excluded (each engine warms on a full drain of
+    the same workload, then ``reset_stats`` before the timed runs —
+    decode/prefill programs are per-instance closures, so ONE instance
+    per side serves warmup + all its timed runs). Also records the
+    serving WARM START: paged engine construction time cold (AOT-compile
+    + store through ``compile_cache=``) vs warm (deserialize), same
+    fingerprint."""
+    import tempfile
+
+    from tpudist import mesh as mesh_lib  # noqa: F401  (device init path)
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.serve import ServeEngine
+    from tpudist.serve.stats import fmt_s
+
+    slots, n_req, block = 8, 32, 32
+    # contiguous side: "xla" = the dense path, which IS its best serving
+    # shape (per-row positions sit above the fused crossover, PERF §7b);
+    # paged side: any non-"xla" impl dispatches the paged Pallas kernel —
+    # the mechanism under test (PERF §7c). Params are architecture-only
+    # and shared across both.
+    model = GPT2(dtype=jnp.bfloat16, max_seq_len=1024, attn_impl="xla")
+    model_paged = GPT2(dtype=jnp.bfloat16, max_seq_len=1024,
+                       attn_impl="fused")
+    rng = np.random.Generator(np.random.PCG64(0))
+    params32 = jax.jit(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, 16), jnp.int32), train=False
+        )["params"]
+    )()
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params32,
+    )
+    system = rng.integers(0, 50257, (64,)).astype(np.int32)
+    plens = rng.integers(16, 129, n_req)
+    budgets = np.minimum(16 + rng.exponential(80.0, n_req), 448.0).astype(
+        np.int32
+    )
+    prompts = [
+        np.concatenate([system, rng.integers(0, 50257, (p,)).astype(np.int32)])
+        for p in plens
+    ]
+    kw = dict(temperature=1.0, top_k=50, top_p=0.95)
+    useful = int(budgets.sum())
+    # arrivals sized off the request count (fixed seconds-per-request
+    # pressure rather than a baseline measurement, so both sides see the
+    # SAME absolute arrival times)
+    gaps = rng.exponential(1.0, n_req - 1)
+    arrivals = np.concatenate([[0.0], np.cumsum(gaps)])
+
+    def drive(engine, window: float):
+        arr = arrivals * (window / max(arrivals[-1], 1e-9))
+        t0 = time.perf_counter()
+        nxt, peak = 0, 0
+        while nxt < n_req or engine.pending:
+            now = time.perf_counter() - t0
+            while nxt < n_req and arr[nxt] <= now:
+                engine.submit(prompts[nxt], int(budgets[nxt]), **kw)
+                nxt += 1
+            if engine.pending:
+                engine.step()
+                peak = max(peak, engine.pool.n_active)
+            elif nxt < n_req:
+                time.sleep(min(0.002, float(arr[nxt]) - now))
+        return time.perf_counter() - t0, peak
+
+    # equal-HBM paged geometry: contiguous bytes = slots × max_seq_len
+    # rows → n_blocks × block rows (+ the reserved garbage block)
+    n_blocks = slots * (model.max_seq_len // block) + 1
+    cold_dir = tempfile.mkdtemp(prefix="tpudist_paged_cc_")
+    t0 = time.perf_counter()
+    paged = ServeEngine(
+        model_paged, params, max_slots=4 * slots, paged=True,
+        block_size=block, n_blocks=n_blocks, compile_cache=cold_dir,
+    )
+    cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm = ServeEngine(
+        model_paged, params, max_slots=4 * slots, paged=True,
+        block_size=block, n_blocks=n_blocks, compile_cache=cold_dir,
+    )
+    warm_s = time.perf_counter() - t0
+    warm_info = dict(warm.compile_cache_info or {})
+    del warm
+    contig = ServeEngine(model, params, max_slots=slots)
+
+    # warm both program inventories on a full drain (compile excluded
+    # from every timed run), then interleave the timed A/B
+    for eng in (contig, paged):
+        for i in range(n_req):
+            eng.submit(prompts[i], int(budgets[i]), **kw)
+        eng.run()
+    # arrival window from a quick contiguous probe: ~30% of its drain
+    contig.reset_stats()
+    probe, _ = drive(contig, 1e-9)
+    window = 0.3 * probe
+    walls = {"contig": [], "paged": []}
+    peaks = {"contig": [], "paged": []}
+    snaps = {}
+    for _ in range(3):
+        for name, eng in (("contig", contig), ("paged", paged)):
+            eng.reset_stats()
+            wall, peak = drive(eng, window)
+            snap = eng.stats.snapshot()
+            assert snap["tokens"] == useful, (name, snap["tokens"], useful)
+            walls[name].append(wall)
+            peaks[name].append(peak)
+            snaps[name] = snap
+    contig_tps = useful / float(np.median(walls["contig"]))
+    paged_tps = useful / float(np.median(walls["paged"]))
+    ratio = paged_tps / contig_tps
+    conc = float(np.median(peaks["paged"])) / max(
+        float(np.median(peaks["contig"])), 1.0
+    )
+    ps, cs = snaps["paged"], snaps["contig"]
+    _record_line(
+        {
+            "metric": "gpt2_124m_paged_serve_tokens_per_sec",
+            "value": round(paged_tps, 2),
+            "unit": "useful tokens/sec, one chip (PAGED engine: "
+            f"{4 * slots} slots over {n_blocks - 1} usable "
+            f"{block}-token blocks = the contiguous {slots}-slot pool's "
+            "exact bytes; prompts 16-128 + shared 64-token system "
+            "prompt, long-tail budgets 16+Exp(80)<=448, Poisson "
+            f"arrivals over {window:.1f}s; interleaved medians of 3, "
+            "compile excluded; contiguous baseline "
+            f"{contig_tps:.1f} tok/s at equal HBM; tok/s ratio "
+            f"{ratio:.2f}x, admitted-concurrency ratio {conc:.2f}x, "
+            f"prefix hit rate {fmt_s(ps['prefix_hit_rate'], digits=3)}, "
+            f"preemptions {ps['preemptions']}; paged TTFT p50/p95 "
+            f"{fmt_s(ps['ttft_p50'])}/{fmt_s(ps['ttft_p95'])}s, TPOT "
+            f"p50/p95 {fmt_s(ps['tpot_p50'], 1e3, 1)}/"
+            f"{fmt_s(ps['tpot_p95'], 1e3, 1)}ms; engine construction "
+            f"cold {cold_s:.1f}s -> warm {warm_s:.1f}s via "
+            "compile_cache; vs_baseline = max(ratio/1.3, conc/2) — >=1 "
+            "meets the >=1.3x tok/s OR >=2x concurrency bar, "
+            "docs/SERVING.md 'Paged memory' + PERF §7c",
+            "contig_tokens_per_sec": round(contig_tps, 2),
+            "tps_ratio": round(ratio, 4),
+            "concurrency_ratio": round(conc, 4),
+            "peak_active_paged": float(np.median(peaks["paged"])),
+            "peak_active_contig": float(np.median(peaks["contig"])),
+            "prefix_hit_rate": ps["prefix_hit_rate"],
+            "preemptions": ps["preemptions"],
+            "pool_occupancy": ps["pool_occupancy"],
+            "paged_ttft_p50_s": ps["ttft_p50"],
+            "paged_ttft_p95_s": ps["ttft_p95"],
+            "paged_tpot_p50_s": ps["tpot_p50"],
+            "paged_tpot_p95_s": ps["tpot_p95"],
+            "contig_ttft_p50_s": cs["ttft_p50"],
+            "contig_ttft_p95_s": cs["ttft_p95"],
+            "contig_tpot_p50_s": cs["tpot_p50"],
+            "contig_tpot_p95_s": cs["tpot_p95"],
+            "engine_build_cold_s": round(cold_s, 3),
+            "engine_build_warm_s": round(warm_s, 3),
+            "compile_cache_warm_hits": warm_info.get("hits"),
+            "vs_baseline": round(max(ratio / 1.3, conc / 2.0), 4),
+        }
+    )
+
+
 def bench_memory_discipline() -> None:
     """The memory-discipline leg (docs/PERF.md §10): a ~1.1B-param GPT-2
     geometry (1536 wide × 36 layers, seq 1024, vocab 50257) budgeted
@@ -2082,6 +2272,10 @@ _LEG_GROUPS = {
     # one static-baseline pass (3 batch shapes) + one engine warmup pass +
     # the timed continuous-batching run
     "serve": (bench_serve, 1800),
+    # paged-vs-contiguous A/B: two engine program inventories (the paged
+    # one compiled twice through the cold->warm compile-cache record),
+    # two warmup drains, then 3 interleaved timed runs per side
+    "paged": (bench_paged_serve, 3600),
     # budgets are eval_shape-only (seconds); the generous cap covers the
     # optional multi-chip dryrun step's compile
     "memory": (bench_memory_discipline, 1500),

@@ -55,7 +55,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
-                 max_len: int = 0, positions=None):
+                 max_len: int = 0, positions=None, block_tables=None):
         b, s, d = x.shape
         h = self.num_heads
         drop = lambda y: (
@@ -91,18 +91,30 @@ class Block(nn.Module):
                     f"attn_impl={self.attn_impl!r} has no decode path; "
                     "generate with the xla/flash model"
                 )
-            from tpudist.ops.decode import cached_kv, decode_attention
+            from tpudist.ops.decode import (
+                cached_kv, decode_attention, paged_decode_attention,
+            )
 
             keys, values, mask, pos = cached_kv(
-                self, k, v, max_len, positions=positions
+                self, k, v, max_len, positions=positions,
+                block_tables=block_tables,
             )
-            # one fused Pallas launch per layer per token unless the caller
-            # pinned the dense oracle (attn_impl="xla") — decode is
-            # launch-bound, not bandwidth-bound (docs/PERF.md §7)
-            attn = decode_attention(
-                q, keys, values, mask, pos,
-                impl="xla" if self.attn_impl == "xla" else "fused",
-            )
+            if block_tables is not None:
+                # paged decode (tpudist.serve.blocks): keys/values are the
+                # SHARED block pool and `mask` the per-row block tables;
+                # the paged kernel walks each row's table up to its cursor
+                attn = paged_decode_attention(
+                    q, keys, values, mask, pos,
+                    impl="xla" if self.attn_impl == "xla" else "paged",
+                )
+            else:
+                # one fused Pallas launch per layer per token unless the
+                # caller pinned the dense oracle (attn_impl="xla") — decode
+                # is launch-bound, not bandwidth-bound (docs/PERF.md §7)
+                attn = decode_attention(
+                    q, keys, values, mask, pos,
+                    impl="xla" if self.attn_impl == "xla" else "fused",
+                )
         elif self.attn_impl in ("ring", "ulysses", "ulysses_flash"):
             # context-parallel attention over the 'seq' mesh axis
             # (tpudist.parallel.cp); activations arrive sequence-sharded and
@@ -254,7 +266,7 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 decode: bool = False, positions=None):
+                 decode: bool = False, positions=None, block_tables=None):
         b, s = tokens.shape
         wte = self.param(
             "wte",
@@ -366,9 +378,10 @@ class GPT2(nn.Module):
                     fused_ln=self.fused_ln, name=f"h_{i}",
                 )(x, train, decode, self.max_seq_len,
                   # only the (remat-free) decode path threads per-slot
-                  # positions; the remat wrapper's static_argnums contract
-                  # stays untouched
-                  **({"positions": positions} if decode else {}))
+                  # positions/block tables; the remat wrapper's
+                  # static_argnums contract stays untouched
+                  **({"positions": positions,
+                      "block_tables": block_tables} if decode else {}))
         if self.fused_ln and not decode:
             from tpudist.ops.layernorm import FusedLayerNorm
 

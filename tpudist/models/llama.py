@@ -78,7 +78,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
-                 max_len: int = 0, positions=None):
+                 max_len: int = 0, positions=None, block_tables=None):
         b, s, d = x.shape
         h, kv = self.num_heads, self.num_kv_heads
         if h % kv:
@@ -118,7 +118,9 @@ class LlamaBlock(nn.Module):
                     f"attn_impl={self.attn_impl!r} has no decode path; "
                     "generate with the xla/flash model"
                 )
-            from tpudist.ops.decode import cached_kv, decode_attention
+            from tpudist.ops.decode import (
+                cached_kv, decode_attention, paged_decode_attention,
+            )
 
             def rope_positions(pos):
                 # scalar cursor: the chunk rows sit at pos..pos+s-1; per-row
@@ -133,16 +135,27 @@ class LlamaBlock(nn.Module):
                                   positions=rope_positions(pos)), v
 
             keys, values, mask, pos = cached_kv(
-                self, k, v, max_len, pre_update=rotate_k, positions=positions
+                self, k, v, max_len, pre_update=rotate_k,
+                positions=positions, block_tables=block_tables,
             )
             q = apply_rope(q, theta=self.rope_theta,
                            positions=rope_positions(pos))
-            # fused path reads grouped K/V heads natively (no repeat in
-            # HBM); the dense oracle repeats inside decode_attention
-            attn = decode_attention(
-                q, keys, values, mask, pos,
-                impl="xla" if self.attn_impl == "xla" else "fused",
-            )
+            if block_tables is not None:
+                # paged decode: keys/values are the shared block pool and
+                # `mask` the per-row block tables (tpudist.serve.blocks);
+                # keys were RoPE-rotated at their absolute positions
+                # before the paged write, same as the contiguous path
+                attn = paged_decode_attention(
+                    q, keys, values, mask, pos,
+                    impl="xla" if self.attn_impl == "xla" else "paged",
+                )
+            else:
+                # fused path reads grouped K/V heads natively (no repeat in
+                # HBM); the dense oracle repeats inside decode_attention
+                attn = decode_attention(
+                    q, keys, values, mask, pos,
+                    impl="xla" if self.attn_impl == "xla" else "fused",
+                )
         else:
             q = apply_rope(q, theta=self.rope_theta)
             k = apply_rope(k, theta=self.rope_theta)
@@ -318,7 +331,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 decode: bool = False, positions=None):
+                 decode: bool = False, positions=None, block_tables=None):
         b, s = tokens.shape
         if s > self.max_seq_len:
             raise ValueError(f"sequence {s} exceeds max_seq_len {self.max_seq_len}")
@@ -384,8 +397,9 @@ class Llama(nn.Module):
                     name=f"layer_{i}",
                 )(x, train, decode, self.max_seq_len,
                   # only the (remat-free) decode path threads per-slot
-                  # positions (same contract as GPT-2)
-                  **({"positions": positions} if decode else {}))
+                  # positions/block tables (same contract as GPT-2)
+                  **({"positions": positions,
+                      "block_tables": block_tables} if decode else {}))
         if self.fused_ln and not decode:
             from tpudist.ops.layernorm import FusedLayerNorm
 

@@ -50,7 +50,8 @@ NEG_INF = float(np.finfo(np.float32).min)
 FUSED_MAX_BATCH = 16
 
 
-def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None):
+def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None,
+              block_tables=None):
     """Append this step's K/V into the module's decode cache.
 
     Must be called inside a flax module's ``__call__`` (it creates
@@ -75,6 +76,19 @@ def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None):
     tree's structure is identical in both modes — a jit'd loop can donate
     the same cache pytree through either path.
 
+    ``block_tables`` (with ``positions``) switches to PAGED decode
+    (``tpudist.serve.blocks``): the cache variables hold the SHARED block
+    pool ``[n_blocks, H, block_size, dh]`` (built by
+    :func:`tpudist.serve.blocks.paged_cache` and passed in — there is no
+    init path for it), and ``block_tables`` is a ``[B, max_blocks]`` int32
+    map from each row's logical block index to its physical pool block.
+    Row ``b``'s K/V is written at
+    ``(table[b, pos_b // block_size], pos_b % block_size)``, and the
+    return switches to ``(k_pool, v_pool, block_tables, positions)`` for
+    :func:`paged_decode_attention`. HBM then holds Σ(actual lengths)
+    instead of ``B × max_len`` — the long-tail serving win (docs/SERVING.md
+    "Paged memory").
+
     Returns ``(keys, values, mask, position)``: the full head-major
     ``[B, H, max_len, dh]`` cache buffers, a ``[1, 1, s, max_len]``
     (scalar mode) or ``[B, 1, 1, max_len]`` (per-row mode) attention mask
@@ -87,6 +101,12 @@ def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None):
     # the init trace only CREATES the cache (shape/dtype); mutating there
     # would hand callers a cache already advanced past position 0
     initialized = module.has_variable("cache", "cached_key")
+    if block_tables is not None and not initialized:
+        raise ValueError(
+            "paged decode has no init path: build the block pool with "
+            "tpudist.serve.blocks.paged_cache and pass it in as the "
+            "'cache' collection"
+        )
     ck = module.variable(
         "cache", "cached_key", jnp.zeros, (b, h, max_len, dh), k.dtype
     )
@@ -96,6 +116,50 @@ def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None):
     ci = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
     )
+    if block_tables is not None:
+        if positions is None:
+            raise ValueError("paged decode needs per-row positions")
+        if s != 1:
+            raise ValueError(
+                f"paged decode is single-token (got chunk {s}); prefill "
+                "runs on a contiguous batch-1 cache and is scattered into "
+                "blocks afterwards (tpudist.serve.blocks)"
+            )
+        pool_k, pool_v = ck.value, cv.value  # [N, H_kv, bs, dh]
+        bs_blk = pool_k.shape[2]
+        pos = jnp.asarray(positions, jnp.int32)
+        bt = jnp.asarray(block_tables, jnp.int32)
+        if pos.shape != (b,):
+            raise ValueError(f"positions must be [{b}], got {pos.shape}")
+        if bt.ndim != 2 or bt.shape[0] != b:
+            raise ValueError(
+                f"block_tables must be [{b}, max_blocks], got {bt.shape}"
+            )
+        if pre_update is not None:
+            k, v = pre_update(k, v, pos)
+        # physical write coordinates: each row's single token lands in the
+        # block its cursor maps to, at the in-block offset
+        blk = jnp.take_along_axis(bt, (pos // bs_blk)[:, None], axis=1)[:, 0]
+        off = pos % bs_blk
+        kt = k[:, 0].astype(pool_k.dtype)  # [B, H_kv, dh]
+        vt = v[:, 0].astype(pool_v.dtype)
+
+        # B sequential single-(block,offset) dynamic_update_slices carried
+        # through a fori_loop: each updates a [1, H, 1, dh] sliver of the
+        # donated pool in place. A gather-scatter (`.at[blk, :, off, :]`)
+        # would block XLA's in-place path and copy the WHOLE pool per
+        # layer per step — the exact copy the paged layout exists to avoid
+        # (the same measurement that shaped the contiguous one-hot write).
+        def write(i, pools):
+            pk, pv = pools
+            start = (blk[i], 0, off[i], 0)
+            pk = jax.lax.dynamic_update_slice(pk, kt[i][None, :, None, :], start)
+            pv = jax.lax.dynamic_update_slice(pv, vt[i][None, :, None, :], start)
+            return pk, pv
+
+        pool_k, pool_v = jax.lax.fori_loop(0, b, write, (pool_k, pool_v))
+        ck.value, cv.value = pool_k, pool_v
+        return pool_k, pool_v, bt, pos
     if positions is not None:
         if s != 1:
             raise ValueError(
@@ -279,3 +343,153 @@ def decode_attention(q, keys, values, mask, pos, *, impl: str = "fused",
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bqhd", probs, values)
+
+
+def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, bs, h, ratio, sm_scale):
+    """One grid step = (batch row b, logical block j): online-softmax
+    accumulation over the row's block-table walk. The k/v BlockSpec index
+    map already resolved logical j to the row's PHYSICAL pool block (and
+    clamped past-the-cursor j to the last needed block, so trailing grid
+    steps re-map the same block and the pipeline issues NO new DMA for
+    them — the bytes read per row are ceil((pos+1)/bs) blocks, not
+    max_blocks). Scratch (m, l, acc) persists across j within a row; the
+    normalized output is (re)written at every valid j, so the last valid
+    block leaves the final answer in the revisited output block."""
+    b_i = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[b_i]
+    last = pos // bs
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j <= last)
+    def _block():
+        def one(i, _):
+            q = q_ref[i]  # [1, dh]
+            k = k_ref[i // ratio]  # [bs, dh]
+            v = v_ref[i // ratio]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # [1, bs]
+            kp = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+            s = jnp.where(kp <= pos, s, NEG_INF)
+            m_prev = m_ref[i]  # [1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = alpha * l_ref[i] + jnp.sum(p, axis=-1)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [1, dh]
+            acc_new = alpha[:, None] * acc_ref[i] + pv
+            m_ref[i], l_ref[i], acc_ref[i] = m_new, l_new, acc_new
+            # j <= last guarantees at least one unmasked slot in this
+            # block (j*bs <= pos), so l_new > 0 — no guard needed
+            o_ref[i] = (acc_new / l_new[:, None]).astype(o_ref.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, h, one, 0)
+
+
+def _paged_fused_attention(q, k_pool, v_pool, block_tables, positions):
+    """q ``[B, 1, H, dh]``, pools ``[n_blocks, H_kv, bs, dh]``,
+    ``block_tables [B, max_blocks]``, ``positions [B]`` → ``[B, 1, H, dh]``.
+
+    Grid is (batch, max_blocks) with the block table and positions as
+    scalar prefetch: the k/v index map reads the row's table to DMA the
+    right PHYSICAL block per logical step, clamping logical blocks past
+    the row's cursor to its last needed block — Pallas skips the DMA when
+    a revisited index maps the same block, so a row at length L reads
+    ceil((L+1)/bs) blocks and the kernel's HBM traffic is Σ(actual
+    lengths), the byte roofline the paged layout buys (vs the dense
+    path's B × max_len gather). Per-block online softmax in VMEM scratch;
+    heads loop in-kernel (the grouping that keeps grid steps DMA-sized,
+    same as the contiguous fused kernel); GQA reads each K/V head once
+    per query group from the grouped pool layout."""
+    b, s_q, h, dh = q.shape
+    h_kv, bs = k_pool.shape[1], k_pool.shape[2]
+    mb = block_tables.shape[1]
+    if s_q != 1:
+        raise NotImplementedError("paged decode attention is single-token")
+    if h % h_kv:
+        raise NotImplementedError(f"q heads {h} not a multiple of kv {h_kv}")
+    ratio = h // h_kv
+    sm_scale = 1.0 / float(np.sqrt(dh))
+    qt = q.reshape(b, h, 1, dh)
+
+    def kv_map(b_i, j, bt, pos):
+        jc = jnp.minimum(j, pos[b_i] // bs)
+        return (bt[b_i, jc], 0, 0, 0)
+
+    q_spec = pl.BlockSpec((None, h, 1, dh), lambda b_i, j, *_: (b_i, 0, 0, 0))
+    kv_spec = pl.BlockSpec((None, h_kv, bs, dh), kv_map)
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, bs=bs, h=h, ratio=ratio, sm_scale=sm_scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, mb),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((h, 1), jnp.float32),   # running max
+                pltpu.VMEM((h, 1), jnp.float32),   # running denominator
+                pltpu.VMEM((h, 1, dh), jnp.float32),  # running numerator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(positions, jnp.int32),
+        qt, k_pool, v_pool,
+    )
+    return out.reshape(b, s_q, h, dh)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
+                           impl: str = "paged"):
+    """Single-token attention over the PAGED pool from :func:`cached_kv`'s
+    block-table mode (``q [B, 1, H, dh]`` activation layout, pools
+    head-major ``[n_blocks, H_kv, block_size, dh]``).
+
+    ``impl="paged"`` runs the one-launch-per-layer Pallas kernel
+    (:func:`_paged_fused_attention`): unlike the contiguous fused kernel
+    it has NO upper batch bound — at serving batch the dense alternative
+    must GATHER every row's max_blocks × block_size window into a
+    contiguous buffer first (B × max_len bytes through HBM), while the
+    kernel walks each row's table and reads only blocks up to the cursor,
+    which is what converts the paged layout's saved bytes into tok/s
+    (docs/PERF.md §7c measures the A/B). ``impl="xla"`` is the
+    gather-then-dense oracle the kernel is tested against (and the
+    correctness path on models pinned to ``attn_impl="xla"``)."""
+    paged_ok = (
+        q.shape[1] == 1
+        and q.shape[2] % k_pool.shape[1] == 0
+        # one block's K+V panel stays far under VMEM at any sane
+        # block_size; no panel bound needed (the whole point: the DMA
+        # unit is a block, not a row's full window)
+    )
+    if impl == "paged" and paged_ok:
+        return _paged_fused_attention(q, k_pool, v_pool, block_tables,
+                                      positions)
+    # dense oracle: gather each row's table into a contiguous window and
+    # reuse the contiguous dense path (per-row mask over slots <= pos)
+    b = q.shape[0]
+    h_kv, bs = k_pool.shape[1], k_pool.shape[2]
+    mb = block_tables.shape[1]
+    bt = jnp.asarray(block_tables, jnp.int32)
+    pos = jnp.asarray(positions, jnp.int32)
+    keys = k_pool[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, mb * bs, -1)
+    values = v_pool[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, mb * bs, -1)
+    slots = jnp.arange(mb * bs)[None, None, None, :]
+    mask = slots <= pos[:, None, None, None]
+    return decode_attention(q, keys, values, mask, pos, impl="xla")

@@ -11,14 +11,23 @@ keeps the decode batch full instead:
 - :mod:`tpudist.serve.slots` — slot-pooled KV cache: one pre-allocated
   ``[max_slots, ...]`` cache with per-slot cursors/masks; requests join
   and leave between decode steps with zero recompiles.
+- :mod:`tpudist.serve.blocks` — PAGED KV cache (``ServeEngine(paged=True)``):
+  a shared refcounted block pool with per-slot block tables and a
+  content-hashed prefix cache, so HBM holds Σ(actual lengths) instead of
+  ``max_slots × max_seq_len`` and shared system prompts pay prefill once
+  (docs/SERVING.md "Paged memory").
 - :mod:`tpudist.serve.prefill` — chunked prefill compiled at a small set
-  of power-of-two bucket lengths, writing prefix K/V into a free slot.
-- :mod:`tpudist.serve.engine` — the scheduler: FIFO admission control,
+  of power-of-two bucket lengths, writing prefix K/V into a free slot
+  (resumable past a prefix-cache hit).
+- :mod:`tpudist.serve.engine` — the scheduler: priority-laned admission
+  control (block-budget accounting + preempt-to-queue in paged mode),
   per-slot sampling/stop params, one compiled masked decode step over the
-  full slot batch, per-step streaming delivery.
+  full slot batch, per-step streaming delivery, optional deploy-time AOT
+  program cache (``compile_cache=``).
 - :mod:`tpudist.serve.stats` — TTFT/TPOT percentiles, queue depth, slot
-  utilization, tokens/s as ``serve`` JSONL rows through the telemetry
-  sink (docs/OBSERVABILITY.md; architecture in docs/SERVING.md).
+  utilization, block-pool occupancy / prefix hit rate / preemptions,
+  tokens/s as ``serve`` JSONL rows through the telemetry sink
+  (docs/OBSERVABILITY.md; architecture in docs/SERVING.md).
 
 Quick start::
 
@@ -29,6 +38,7 @@ Quick start::
     results = engine.run()   # or: for ev in engine.events(): ...
 """
 
+from tpudist.serve.blocks import BlockPool, PagedSlotPool, PrefixCache
 from tpudist.serve.engine import (
     NO_EOS,
     QueueFull,
@@ -49,5 +59,8 @@ __all__ = [
     "Prefiller",
     "SlotPool",
     "write_slot",
+    "BlockPool",
+    "PagedSlotPool",
+    "PrefixCache",
     "ServeStats",
 ]

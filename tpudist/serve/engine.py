@@ -1,21 +1,23 @@
 """Continuous-batching inference engine.
 
-The scheduler over the slot pool: a FIFO request queue with admission
-control, per-slot sampling/stop params, per-step streaming token delivery,
-and latency-SLO telemetry. One scheduler **tick** (:meth:`ServeEngine.step`)
-is:
+The scheduler over the slot pool: a priority-laned request queue with
+admission control, per-slot sampling/stop params, per-step streaming token
+delivery, and latency-SLO telemetry. One scheduler **tick**
+(:meth:`ServeEngine.step`) is:
 
 1. **admit** — while a slot is free, the active count is under
-   ``max_active``, and the queue is non-empty: pop the oldest request,
-   run its bucketed chunked prefill (``tpudist.serve.prefill``), sample
-   its FIRST token from the prefill logits (that emission is the
-   request's TTFT), and scatter its prefix K/V into a free slot;
+   ``max_active``, and the budget holds (slot count on the contiguous
+   pool; BLOCK budget on the paged pool): pop the most urgent queued
+   request, run its bucketed chunked prefill (``tpudist.serve.prefill``
+   — resumed past any prefix-cache hit), sample its FIRST token from the
+   prefill logits (that emission is the request's TTFT), and map its
+   prefix K/V into a slot;
 2. **dispatch** — ONE compiled masked decode step over the FULL slot batch
-   (``positions=`` per-slot cursors, non-live slots ride along masked):
-   write each fed token's K/V at its slot's cursor, sample each slot's
-   next token with its own sampling params and rng stream
-   (:func:`tpudist.generate.sample_logits_per_row`), apply the shared
-   stop rule (:func:`tpudist.generate.eos_retire`);
+   (``positions=`` per-slot cursors — plus per-slot block tables in paged
+   mode — non-live slots ride along masked): write each fed token's K/V
+   at its slot's cursor, sample each slot's next token with its own
+   params and rng stream (:func:`tpudist.generate.sample_logits_per_row`),
+   apply the shared stop rule (:func:`tpudist.generate.eos_retire`);
 3. **process** — fetch the PREVIOUS tick's dispatched step, stream its
    tokens, and retire finished slots (stop token or budget), making room
    for the next admission — requests join and leave between decode steps
@@ -34,21 +36,51 @@ masked zombie row-step (its write lands at its own cursor and the slot
 is released before anything reads it), and the ``(request_id, slot
 ownership)`` snapshot guard discards the zombie's output.
 
+**Paged mode** (``paged=True``, docs/SERVING.md "Paged memory"): the KV
+cache becomes a shared block pool with per-slot block tables
+(:mod:`tpudist.serve.blocks`), so HBM holds Σ(actual lengths) instead of
+``max_slots × max_seq_len`` and ``max_slots`` can rise to whatever the
+byte budget actually supports under the traffic's length distribution.
+Three scheduler behaviors only exist there:
+
+- **block-budget admission**: a request admits when the pool can map its
+  (post-prefix-hit) prompt plus ``watermark_blocks`` of decode headroom,
+  evicting cold prefix-cache leaves first — slot count alone no longer
+  measures capacity;
+- **prefix cache**: completed prompt-prefix blocks are content-hashed and
+  shared copy-on-write at block granularity, so requests repeating a
+  system prompt skip its prefill (TTFT drops to ~one chunk) and share
+  its bytes;
+- **preempt-to-queue**: when the pool runs dry mid-decode (a slot's
+  cursor needs a block and eviction finds none), the newest
+  lowest-priority slot is evicted back to the FRONT of its lane — its
+  blocks free NOW, its prompt+progress replay at re-admission (prefix
+  cache usually making the replay cheap), and its token stream continues
+  exactly where it stopped (the replayed request re-enters decode at the
+  same cursor, rng stream, and sampling state — greedy output stays
+  bit-identical through an eviction cycle, pinned by test).
+
+**Priority lanes**: ``submit(priority=N)`` — admission always serves the
+highest-priority non-empty lane, FIFO within a lane, UNLESS
+``ttft_slo_s`` is set and a lower lane's head has waited past it (then
+the oldest overdue head goes first — TTFT-deadline-driven aging, fed by
+the same clock ``stats.py`` measures TTFT with, so starvation surfaces
+in the ``serve`` rows exactly when the scheduler acts on it).
+
 Why this wins over static batching: a static batch must assemble before
 prefill (queue wait on the LAST arrival) and every row decodes until the
 LONGEST request finishes (retired rows burn full decode steps). The
 engine's decode batch stays full under mixed-length Poisson arrivals —
-the ``serve`` bench leg measures the tokens/s gap and the TTFT collapse.
-
-The decode step costs the same whether 1 or ``max_slots`` slots are
-live (the batch shape is fixed); ``max_slots`` trades HBM (the pool is
-``max_slots × depth × 2 × H × max_seq_len × dh``) against utilization.
+the ``serve`` bench leg measures the tokens/s gap and the TTFT collapse;
+the ``paged`` leg measures what the block pool adds at equal HBM.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
+import json
 import time
 from functools import partial
 
@@ -79,6 +111,13 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     eos_id: int = NO_EOS
+    priority: int = 0
+    # a preempted request re-queues with the tokens it already emitted:
+    # re-admission rebuilds its K/V (prompt + replay[:-1]) via prefill —
+    # prefix-cache hits making most of that a gather — and feeds
+    # replay[-1] as the next step's input, continuing the stream without
+    # re-emitting anything
+    replay_tokens: tuple | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,14 +145,15 @@ class _Inflight:
     rid: np.ndarray    # [S] int64 — owner snapshot
 
 
-def _build_decode_step(model, params, base_key):
+def _build_decode_step(model, params, base_key, paged: bool):
     """The one compiled decode step over the full slot batch: feed each
     slot's last token (the PREVIOUS step's on-device sample, or the
     admission override for slots that just joined) at its own position,
     sample each slot's next token with its own params from its own rng
     stream, apply the shared stop rule. Non-live slots arrive with
     ``done=True``: they emit the pad id and their (masked, later
-    overwritten) cache writes are dead.
+    overwritten) cache writes are dead — in paged mode those ride-along
+    writes land in the reserved garbage block their all-zero tables map.
 
     ``model``/``params``/``base_key`` are CLOSURE constants, not traced
     arguments (one compiled step per engine instance): with params as jit
@@ -124,17 +164,19 @@ def _build_decode_step(model, params, base_key):
     one call amortizes that over the whole in-graph scan; the engine
     calls once per token and cannot."""
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def step(cache, prev_tok, override_tok, use_override, pos, done,
-             req_ids, tok_idx, temperature, top_k, top_p, eos):
+    def body(cache, prev_tok, override_tok, use_override, pos, done,
+             req_ids, tok_idx, temperature, top_k, top_p, eos,
+             block_tables=None):
         tok = jnp.where(use_override, override_tok, prev_tok)
+        extra = {} if block_tables is None else {"block_tables": block_tables}
         logits, updates = model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, decode=True, mutable=["cache"], positions=pos,
+            **extra,
         )
         # per-slot rng streams: (request id, token index) keys the draw,
         # so a slot's stream is independent of which other requests share
-        # the batch
+        # the batch — and survives a preempt/replay cycle unchanged
         keys = jax.vmap(
             lambda r, t: jax.random.fold_in(jax.random.fold_in(base_key, r), t)
         )(req_ids, tok_idx)
@@ -144,6 +186,23 @@ def _build_decode_step(model, params, base_key):
         )
         nxt, done = eos_retire(nxt, done, eos, 0)
         return updates["cache"], nxt, done
+
+    if paged:
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(cache, prev_tok, override_tok, use_override, pos,
+                 block_tables, done, req_ids, tok_idx, temperature, top_k,
+                 top_p, eos):
+            return body(cache, prev_tok, override_tok, use_override, pos,
+                        done, req_ids, tok_idx, temperature, top_k, top_p,
+                        eos, block_tables=block_tables)
+
+        return step
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(cache, prev_tok, override_tok, use_override, pos, done,
+             req_ids, tok_idx, temperature, top_k, top_p, eos):
+        return body(cache, prev_tok, override_tok, use_override, pos, done,
+                    req_ids, tok_idx, temperature, top_k, top_p, eos)
 
     return step
 
@@ -164,16 +223,39 @@ def _first_token(logits, base_key, request_id, temperature, top_k, top_p):
 class ServeEngine:
     """Continuous-batching engine over a model with the decode contract
     (GPT-2 / Llama: ``decode=True`` + ``cache`` collection + per-row
-    ``positions``).
+    ``positions``; paged mode additionally threads ``block_tables``).
 
-    ``max_slots`` sizes the KV pool (the decode batch); ``max_active``
-    (default ``max_slots``) caps concurrently-decoding requests below the
-    pool size when prefill latency must be bounded; ``max_queue`` bounds
-    admission (submit raises :class:`QueueFull` beyond it). ``sink`` (a
+    ``max_slots`` sizes the decode batch; ``max_active`` (default
+    ``max_slots``) caps concurrently-decoding requests below it when
+    prefill latency must be bounded; ``max_queue`` bounds admission
+    (submit raises :class:`QueueFull` beyond it). ``sink`` (a
     :class:`tpudist.telemetry.TelemetrySink`) streams ``serve`` rows every
     ``stats_every`` ticks; ``on_token`` is the streaming callback, called
     with each :class:`TokenEvent` as it is emitted (one tick after its
     dispatch — the delayed-fetch pipeline).
+
+    Paged-mode knobs (``paged=True``): ``block_size`` (must divide
+    ``model.max_seq_len``), ``n_blocks`` (default: the contiguous pool's
+    byte budget, ``max_slots × max_seq_len / block_size``, plus the
+    garbage block — size it DOWN and raise ``max_slots`` to serve more
+    concurrency from the same HBM; docs/SERVING.md "Paged memory" has the
+    sizing math), ``prefix_cache`` (content-hash completed prompt-prefix
+    blocks for sharing), ``watermark_blocks`` (admission headroom kept
+    free for live slots' decode growth; default ``max_slots``).
+    ``ttft_slo_s`` arms priority-lane aging (module docstring).
+
+    ``compile_cache=dir`` routes the engine's compiled program inventory
+    (the decode step + the per-bucket prefill programs) through
+    :class:`tpudist.compile_cache.CompileCache`: construction AOT-compiles
+    everything NOW (deploy-time, instead of lazily on first traffic) and
+    a REDEPLOYED server with the same weights/geometry loads the
+    serialized executables instead of re-tracing — engine cold-start is a
+    recorded number (``compile_cache_info``), not a first-request tax.
+    The key fingerprints the param VALUES (the programs close over the
+    weights, so the serialized payload embeds them): one hashing pass
+    over the params at construction, and a new checkpoint can never be
+    served by a stale executable. Fail-soft like the training cache — a
+    load or first-call failure falls back to the jit path permanently.
 
     ``retain_results=False`` drops a request's state (its accumulated
     token list) the moment it completes — the long-lived-server mode:
@@ -186,7 +268,11 @@ class ServeEngine:
                  max_active: int | None = None, max_queue: int = 256,
                  prefill_chunk: int = 512, seed: int = 0, sink=None,
                  stats_every: int = 50, on_token=None,
-                 retain_results: bool = True, clock=time.perf_counter):
+                 retain_results: bool = True, clock=time.perf_counter,
+                 paged: bool = False, block_size: int = 32,
+                 n_blocks: int | None = None, prefix_cache: bool = True,
+                 watermark_blocks: int | None = None,
+                 ttft_slo_s: float | None = None, compile_cache=None):
         self.model = model
         self.params = params
         self.max_active = max_slots if max_active is None else max_active
@@ -195,18 +281,42 @@ class ServeEngine:
                 f"max_active {self.max_active} outside [1, {max_slots}]"
             )
         self.max_queue = max_queue
-        self.pool = SlotPool(model, max_slots)
+        self.paged = bool(paged)
+        if self.paged:
+            from tpudist.serve.blocks import PagedSlotPool
+
+            if n_blocks is None:
+                # equal-HBM default: the contiguous pool's bytes, paged
+                # (+1 for the reserved garbage block). Sizing n_blocks
+                # DOWN while raising max_slots is the point of the layout.
+                n_blocks = max_slots * (model.max_seq_len // block_size) + 1
+            self.pool = PagedSlotPool(
+                model, max_slots, n_blocks=n_blocks, block_size=block_size,
+                prefix_cache=prefix_cache,
+            )
+            self.watermark = (
+                max_slots if watermark_blocks is None else int(watermark_blocks)
+            )
+        else:
+            self.pool = SlotPool(model, max_slots)
+            self.watermark = 0
         self.prefiller = Prefiller(model, params, chunk=prefill_chunk)
         self.on_token = on_token
+        self.ttft_slo_s = ttft_slo_s
         self.stats = ServeStats(
-            slots=max_slots, sink=sink, every=stats_every, clock=clock
+            slots=max_slots, sink=sink, every=stats_every, clock=clock,
+            paged=self.paged,
         )
         self._base_key = jax.random.key(seed)
-        self._decode_fn = _build_decode_step(model, params, self._base_key)
-        self._queue: collections.deque[Request] = collections.deque()
+        self._decode_fn = _build_decode_step(
+            model, params, self._base_key, self.paged
+        )
+        self._lanes: dict[int, collections.deque[Request]] = {}
+        self._t_submit: dict[int, float] = {}
         self.retain_results = retain_results
         self._results: dict[int, list[int]] = {}
         self._counts: dict[int, int] = {}  # emitted per LIVE request
+        self._live_toks: dict[int, list[int]] = {}  # emitted values (replay)
         self._next_id = 0
         self._step = 0
         s = max_slots
@@ -219,23 +329,34 @@ class ServeEngine:
         self._topk = np.zeros(s, np.int32)
         self._topp = np.ones(s, np.float32)
         self._eos = np.full(s, NO_EOS, np.int32)
+        self._slot_prio = np.zeros(s, np.int32)
+        self._admit_seq = np.zeros(s, np.int64)  # victim choice: newest first
+        self._seq = 0
+        self._slot_req: dict[int, Request] = {}  # original request per slot
         # the device-carried token feedback (each step's samples feed the
         # next step without a host round-trip) and the admission overrides
         # that splice a new request's first token into its slot's lane
         self._prev_tok = jnp.zeros(s, jnp.int32)
         self._override: dict[int, int] = {}
         self._inflight: _Inflight | None = None
+        self._drained_events: list[TokenEvent] = []
+        self._decode_aot: dict | None = None
+        self.compile_cache_info: dict | None = None
+        if compile_cache is not None:
+            self._setup_compile_cache(compile_cache, seed=seed)
 
     # -- submission --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               eos_id: int | None = None) -> int:
+               eos_id: int | None = None, priority: int = 0) -> int:
         """Enqueue a request; returns its id. Sampling params are
         PER-REQUEST (``temperature=0`` greedy, ``top_k<=0`` / ``top_p>=1``
-        off — :func:`tpudist.generate.sample_logits_per_row` semantics).
-        Raises :class:`QueueFull` past ``max_queue`` and ``ValueError``
-        when the request cannot fit the KV pool."""
+        off — :func:`tpudist.generate.sample_logits_per_row` semantics);
+        ``priority`` picks the lane (higher = served first, subject to
+        ``ttft_slo_s`` aging). Raises :class:`QueueFull` past
+        ``max_queue`` and ``ValueError`` when the request cannot fit the
+        KV budget (per-slot window, and in paged mode the block pool)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             # reject HERE like every other bad request: deferred to the
@@ -248,46 +369,64 @@ class ServeEngine:
                 f"prompt {prompt.size} + {max_new_tokens} new tokens exceeds "
                 f"max_seq_len {self.model.max_seq_len} (the per-slot KV size)"
             )
-        if len(self._queue) >= self.max_queue:
+        if self.paged:
+            worst = self.pool.blocks_for(prompt.size + max_new_tokens)
+            if worst > self.pool.blocks.n_usable:
+                raise ValueError(
+                    f"request needs up to {worst} blocks but the pool has "
+                    f"{self.pool.blocks.n_usable}; raise n_blocks"
+                )
+        if self.queue_depth >= self.max_queue:
             raise QueueFull(
                 f"request queue at max_queue={self.max_queue}; shed load"
             )
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(Request(
+        req = Request(
             rid, prompt, int(max_new_tokens), float(temperature),
             int(top_k or 0), float(1.0 if top_p is None else top_p),
-            NO_EOS if eos_id is None else int(eos_id),
-        ))
+            NO_EOS if eos_id is None else int(eos_id), int(priority),
+        )
+        self._lanes.setdefault(req.priority, collections.deque()).append(req)
         self._counts[rid] = 0
+        if self.paged:
+            self._live_toks[rid] = []
         if self.retain_results:
             self._results[rid] = []
-        self.stats.on_submit(rid)
+        self._t_submit[rid] = self.stats.on_submit(rid)
         return rid
 
     # -- scheduler ---------------------------------------------------------
 
     @property
     def pending(self) -> bool:
-        return (bool(self._queue) or self.pool.n_active > 0
+        return (self.queue_depth > 0 or self.pool.n_active > 0
                 or self._inflight is not None)
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return sum(len(d) for d in self._lanes.values())
 
     def step(self) -> list[TokenEvent]:
         """One scheduler tick: admit, dispatch, process. Returns the
         tokens emitted this tick (also delivered to ``on_token``) — a
         dispatched token surfaces on the NEXT tick's process phase."""
         events = self._admit()
-        prev, self._inflight = self._inflight, self._dispatch()
-        if prev is not None:
-            events.extend(self._process(prev))
+        self._drained_events = []
+        new_inflight = self._dispatch()
+        # a preemption inside _dispatch force-fetched the in-flight step
+        # (its retirements can free blocks) — surface those tokens now
+        events.extend(self._drained_events)
+        if self._inflight is not None:
+            events.extend(self._process(self._inflight))
+        self._inflight = new_inflight
         self._step += 1
         self.stats.on_tick(
-            self._step, queue_depth=len(self._queue),
+            self._step, queue_depth=self.queue_depth,
             active=self.pool.n_active,
+            pool_occupancy=(
+                self.pool.blocks.occupancy if self.paged else None
+            ),
         )
         if self.on_token is not None:
             for e in events:
@@ -325,7 +464,7 @@ class ServeEngine:
         s = self.stats
         self.stats = ServeStats(
             slots=self.pool.max_slots, sink=s.sink, every=s.every,
-            clock=s._clock,
+            clock=s._clock, paged=self.paged,
         )
 
     # -- internals ---------------------------------------------------------
@@ -333,6 +472,11 @@ class ServeEngine:
     def _emit(self, rid: int, token: int, done: bool) -> TokenEvent:
         ev = TokenEvent(rid, token, self._counts[rid], done)
         self._counts[rid] += 1
+        if self.paged:
+            # replay record for preempt-to-queue — paged-only machinery;
+            # a contiguous streaming server should not pay double host
+            # memory per live token for a list nothing ever reads
+            self._live_toks[rid].append(token)
         if self.retain_results:
             self._results[rid].append(token)
         return ev
@@ -340,44 +484,238 @@ class ServeEngine:
     def _finish(self, rid: int) -> None:
         """Request complete: close out its SLO accounting and (in
         streaming mode) drop its per-request state — host memory stays
-        bounded by live requests, not by every request ever served."""
+        bounded by live requests, not requests ever served."""
         self.stats.on_done(rid, self._counts.pop(rid))
+        self._live_toks.pop(rid, None)
+        self._t_submit.pop(rid, None)
         if not self.retain_results:
             self._results.pop(rid, None)
 
+    def _peek_next(self) -> tuple[int, Request] | None:
+        """The lane/request admission would serve next: highest-priority
+        non-empty lane's head, unless ``ttft_slo_s`` aging promotes an
+        overdue lower lane's head (oldest overdue first)."""
+        heads = [(lane, dq[0]) for lane, dq in self._lanes.items() if dq]
+        if not heads:
+            return None
+        if self.ttft_slo_s is not None:
+            now = self.stats._clock()
+            overdue = [
+                (lane, r) for lane, r in heads
+                if now - self._t_submit.get(r.request_id, now)
+                > self.ttft_slo_s
+            ]
+            if overdue:
+                return min(
+                    overdue,
+                    key=lambda lr: self._t_submit.get(
+                        lr[1].request_id, float("inf")
+                    ),
+                )
+        return max(heads, key=lambda lr: lr[0])
+
     def _admit(self) -> list[TokenEvent]:
         events: list[TokenEvent] = []
-        while (self._queue and self.pool.n_free > 0
-               and self.pool.n_active < self.max_active):
-            req = self._queue.popleft()
-            row_cache, last_logits = self.prefiller(req.prompt)
-            tok = int(_first_token(
-                last_logits, self._base_key,
-                jnp.asarray(req.request_id, jnp.int32),
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_k, jnp.int32),
-                jnp.asarray(req.top_p, jnp.float32),
-            ))
-            self.stats.on_first_token(req.request_id)
-            done = tok == req.eos_id or req.max_new_tokens == 1
-            events.append(self._emit(req.request_id, tok, done))
-            if done:
-                # one-token request (or instant EOS): never occupies a slot
-                self._finish(req.request_id)
-                continue
+        while self.pool.n_free > 0 and self.pool.n_active < self.max_active:
+            picked = self._peek_next()
+            if picked is None:
+                break
+            lane, req = picked
+            replay = req.replay_tokens
+            # the K/V the slot must hold before its first dispatch: the
+            # prompt for a fresh request; prompt + all-but-the-last
+            # emitted token for a replay (the last one is the next step's
+            # INPUT, exactly the steady-state shape)
+            if replay is not None:
+                kv_tokens = np.concatenate(
+                    [req.prompt, np.asarray(replay[:-1], np.int32)]
+                )
+            else:
+                kv_tokens = req.prompt
+            hit_blocks: list[int] = []
+            lookup_blocks = 0
+            if self.paged:
+                bs = self.pool.block_size
+                worst = self.pool.blocks_for(len(kv_tokens))
+                # a fresh request must re-run its LAST prompt token (its
+                # logits are the first sample); a replay needs no logits,
+                # so its whole K/V may come from the cache
+                limit = (len(kv_tokens) if replay is not None
+                         else len(kv_tokens) - 1)
+                max_hits = (
+                    0 if self.pool.prefix is None
+                    else max(min(limit, len(kv_tokens)), 0) // bs
+                )
+                # the watermark is decode headroom against the OTHER live
+                # slots' growth; on an idle pool there is nothing to
+                # thrash against, and insisting on it would make a
+                # request whose need_new + watermark exceeds the pool
+                # permanently unadmittable (head-of-line livelock) even
+                # though submit() verified it fits
+                wm = self.watermark if self.pool.n_active else 0
+                if self.pool.free_after_evict() < worst - max_hits + wm:
+                    # even a FULL prefix hit cannot fit: stop admitting
+                    # before paying the prompt hash + pin work this tick
+                    # (FIFO head-of-line — the request stays queued,
+                    # decode drains the pool; a blocked tick costs one
+                    # evictability scan, not O(prompt) hashing)
+                    break
+                if self.pool.prefix is not None:
+                    hit_blocks = self.pool.prefix.lookup(kv_tokens, limit)
+                    lookup_blocks = max_hits
+                    # PIN the hits until insert takes its own refs: the
+                    # eviction below frees cache-only (refcount-1) leaves,
+                    # and the matched blocks are exactly that until the
+                    # slot maps them — without the pin a budget eviction
+                    # could free the blocks this admission is about to use
+                    for blk in hit_blocks:
+                        self.pool.blocks.incref(int(blk))
+                budget = worst - len(hit_blocks) + wm
+                if self.pool.free_after_evict() < budget:
+                    # the actual hits fell short of the optimistic
+                    # pre-check (and the pins just excluded them from the
+                    # evictable count): release and stay queued
+                    for blk in hit_blocks:
+                        self.pool.blocks.decref(int(blk))
+                    break
+                if self.pool.blocks.n_free < budget:
+                    self.pool.evict_prefix(budget - self.pool.blocks.n_free)
+            self._lanes[lane].popleft()
+            if self.paged and self.pool.prefix is not None:
+                # record the prefix outcome only for COMMITTED admissions:
+                # a budget-blocked head retries the lookup every tick, and
+                # counting those attempts would let one stuck request
+                # inflate prefix_hit_rate with phantom lookups
+                self.stats.on_prefix(len(hit_blocks), lookup_blocks)
+            n_hit_tokens = len(hit_blocks) * (
+                self.pool.block_size if self.paged else 0
+            )
+            if self.paged and hit_blocks:
+                if n_hit_tokens < len(kv_tokens):
+                    row_cache, last_logits = self.prefiller.resume(
+                        self.pool.gather_row(hit_blocks), kv_tokens,
+                        n_hit_tokens,
+                    )
+                else:
+                    # full-hit replay: every block is shared and insert
+                    # scatters nothing — skip the whole-window gather too
+                    row_cache, last_logits = None, None
+            else:
+                row_cache, last_logits = self.prefiller(kv_tokens)
+            if replay is None:
+                tok = int(_first_token(
+                    last_logits, self._base_key,
+                    jnp.asarray(req.request_id, jnp.int32),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_k, jnp.int32),
+                    jnp.asarray(req.top_p, jnp.float32),
+                ))
+                self.stats.on_first_token(req.request_id)
+                done = tok == req.eos_id or req.max_new_tokens == 1
+                events.append(self._emit(req.request_id, tok, done))
+                if done:
+                    # one-token request (or instant EOS): never occupies
+                    # a slot — release the prefix pins insert would have
+                    # taken over, or the hit blocks' refcounts stay
+                    # elevated forever (unevictable, never freed)
+                    for blk in hit_blocks:
+                        self.pool.blocks.decref(int(blk))
+                    self._finish(req.request_id)
+                    continue
+                override, n_disp = tok, 1
+            else:
+                # re-admission after preemption: everything through
+                # replay[-1] was already emitted; feed it back and resume
+                # the stream at the same cursor/rng position
+                override, n_disp = int(replay[-1]), len(replay)
             # the pool write composes with an in-flight decode step: the
             # pool's cache is already the dispatched step's output future,
             # and the scatter simply queues behind it on the device stream
-            slot = self.pool.insert(row_cache, req.prompt.size)
+            if self.paged:
+                slot = self.pool.insert(
+                    row_cache, len(kv_tokens), prompt=kv_tokens,
+                    hit_blocks=hit_blocks,
+                )
+                for blk in hit_blocks:  # insert holds its own refs now
+                    self.pool.blocks.decref(int(blk))
+            else:
+                slot = self.pool.insert(row_cache, len(kv_tokens))
             self._req[slot] = req.request_id
-            self._dispatched[slot] = 1
+            self._dispatched[slot] = n_disp
             self._budget[slot] = req.max_new_tokens
             self._temp[slot] = req.temperature
             self._topk[slot] = req.top_k
             self._topp[slot] = req.top_p
             self._eos[slot] = req.eos_id
-            self._override[slot] = tok
+            self._slot_prio[slot] = req.priority
+            self._seq += 1
+            self._admit_seq[slot] = self._seq
+            self._slot_req[slot] = req
+            self._override[slot] = override
         return events
+
+    def _choose_victim(self) -> int | None:
+        """The slot preemption evicts when the pool runs dry: lowest
+        priority first, newest admission within a priority (LIFO — the
+        request that has invested least, and whose re-queue at the front
+        of its lane costs the least reordering)."""
+        cands = np.nonzero(self.pool.active)[0]
+        if cands.size == 0:
+            return None
+        return int(min(
+            cands,
+            key=lambda s: (self._slot_prio[s], -self._admit_seq[s]),
+        ))
+
+    def _preempt(self, victim: int) -> None:
+        """Evict a live slot back to its lane's FRONT: its blocks free
+        now, its request replays at re-admission (the in-flight step was
+        already drained by the caller, so the emitted-token record is
+        complete and the stream resumes exactly where it stopped)."""
+        rid = int(self._req[victim])
+        orig = self._slot_req[victim]
+        req = dataclasses.replace(
+            orig, replay_tokens=tuple(self._live_toks.get(rid, ()))
+        )
+        self._lanes.setdefault(req.priority, collections.deque()).appendleft(
+            req
+        )
+        self._override.pop(victim, None)
+        self._slot_req.pop(victim, None)
+        self.pool.release(victim)
+        self._req[victim] = -1
+        self.stats.on_preempt(rid)
+
+    def _ensure_blocks(self, live: np.ndarray) -> np.ndarray:
+        """Paged pre-dispatch pass: every live slot whose cursor crossed a
+        block boundary must map a fresh block before the step runs. When
+        the pool is dry the escalation ladder is: (1) force-fetch the
+        in-flight step — its retirements may free blocks (one extra host
+        sync, only on the pressure path); (2) evict a cold prefix-cache
+        leaf; (3) preempt the newest lowest-priority slot to the queue.
+        The loop terminates because every preemption removes a slot from
+        ``live`` — in the worst case the requesting slot preempts
+        itself."""
+        for slot in np.nonzero(live)[0]:
+            while live[slot] and not self.pool.ensure_next(slot):
+                if self._inflight is not None:
+                    self._drained_events.extend(
+                        self._process(self._inflight)
+                    )
+                    self._inflight = None
+                    live &= self.pool.active & (
+                        self._dispatched < self._budget
+                    )
+                    continue
+                if self.pool.evict_prefix(1):
+                    continue
+                victim = self._choose_victim()
+                if victim is None:  # no active slots left to free
+                    live[slot] = False
+                    break
+                self._preempt(victim)
+                live[victim] = False
+        return live
 
     def _dispatch(self) -> _Inflight | None:
         """Dispatch the next decode step without waiting on the previous
@@ -385,6 +723,8 @@ class ServeEngine:
         whose stop token sits in the unfetched step rides one extra masked
         zombie row (discarded at process time by the ownership guard)."""
         live = self.pool.active & (self._dispatched < self._budget)
+        if self.paged and live.any():
+            live = self._ensure_blocks(live)
         if not live.any():
             return None
         override_tok = np.zeros(self.pool.max_slots, np.int32)
@@ -393,14 +733,28 @@ class ServeEngine:
             override_tok[slot] = tok
             use_override[slot] = True
         self._override.clear()
-        self.pool.cache, tok_dev, done_dev = self._decode_fn(
+        # every host array is SNAPSHOTTED (.copy()/astype) before it
+        # becomes a device argument: XLA:CPU's device_put zero-copy
+        # ALIASES aligned numpy buffers, and under async dispatch the
+        # step may read them only after this tick's host-side bookkeeping
+        # (advance/admission) has already mutated them in place —
+        # reproduced on jax 0.4.x as per-process-deterministic corrupted
+        # token streams, pinned by test_serve_paged's aliasing regression
+        # test. The copies are tiny ([S]-scalar lanes and the [S, MB]
+        # table) next to the decode step itself.
+        args = [
             self.pool.cache, self._prev_tok, jnp.asarray(override_tok),
-            jnp.asarray(use_override), jnp.asarray(self.pool.positions),
+            jnp.asarray(use_override), jnp.asarray(self.pool.positions.copy()),
+        ]
+        if self.paged:
+            args.append(jnp.asarray(self.pool.tables.copy()))
+        args += [
             jnp.asarray(~live), jnp.asarray(self._req.astype(np.int32)),
-            jnp.asarray(self._dispatched), jnp.asarray(self._temp),
-            jnp.asarray(self._topk), jnp.asarray(self._topp),
-            jnp.asarray(self._eos),
-        )
+            jnp.asarray(self._dispatched.copy()), jnp.asarray(self._temp.copy()),
+            jnp.asarray(self._topk.copy()), jnp.asarray(self._topp.copy()),
+            jnp.asarray(self._eos.copy()),
+        ]
+        self.pool.cache, tok_dev, done_dev = self._call_decode(*args)
         self._prev_tok = tok_dev
         for slot in np.nonzero(live)[0]:
             self.pool.advance(slot)
@@ -431,5 +785,150 @@ class ServeEngine:
                 self._finish(rid)
                 self.pool.release(slot)
                 self._req[slot] = -1
+                self._slot_req.pop(slot, None)
         self.stats.on_decode_step(int(prev.live.sum()), len(events))
         return events
+
+    # -- deploy-time compile cache (warm start) ----------------------------
+
+    def _call_decode(self, *args):
+        """Dispatch through the cached AOT executable when one loaded;
+        any failure (geometry the fingerprint couldn't see) permanently
+        falls back to the jit path — the cache may cost a trace, never a
+        wrong step. The fallback boundary is PRE-dispatch: an input
+        mismatch raises at the executable's argument validation, before
+        donation invalidates the cache buffers, so re-invoking the jit
+        path on the same args is safe. A fault AFTER dispatch (device
+        OOM mid-step) leaves the donated cache deleted and the retry
+        dies on it — correct, since the cache contents are undefined at
+        that point and no fallback could serve them."""
+        if self._decode_aot is not None and self._decode_aot["exe"] is not None:
+            try:
+                return self._decode_aot["exe"](*args)
+            except Exception:
+                self._decode_aot["exe"] = None
+        return self._decode_fn(*args)
+
+    def _fingerprint(self, seed: int) -> str:
+        """Content hash of everything the engine's executables bake in:
+        model identity/config, engine geometry, jax versions, backend —
+        and the PARAM VALUES, because the programs close over the weights
+        (the serialized payload embeds them; a redeployed server with a
+        new checkpoint must miss, or it would silently serve the old
+        weights). One hashing pass over the params at construction — the
+        deploy-time cost of the warm start."""
+        from tpudist.compile_cache import SCHEMA, model_identity
+
+        h = hashlib.sha256()
+        cfg = {
+            "schema": SCHEMA,
+            "model": model_identity(self.model),
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "max_slots": self.pool.max_slots,
+            "max_seq_len": self.model.max_seq_len,
+            "paged": self.paged,
+            "block_size": getattr(self.pool, "block_size", 0),
+            "n_blocks": (
+                self.pool.blocks.n_blocks if self.paged else 0
+            ),
+            "chunk": self.prefiller.chunk,
+            "minimum": self.prefiller.minimum,
+            "seed": seed,
+        }
+        h.update(json.dumps(cfg, sort_keys=True).encode())
+        flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        for path, leaf in flat:
+            arr = np.asarray(jax.device_get(leaf))
+            h.update(jax.tree_util.keystr(path).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()[:24]
+
+    def _setup_compile_cache(self, directory, *, seed: int) -> None:
+        """Deploy-time program inventory through the AOT executable cache:
+        the decode step plus every power-of-two prefill bucket's body/
+        final program, compiled NOW (cold) or deserialized (warm). Rare
+        shapes outside the inventory (a capped non-power-of-two final
+        bucket near the cache end) simply take the jit path."""
+        from tpudist.compile_cache import CompileCache
+
+        t0 = time.perf_counter()
+        cc = CompileCache(directory)
+        fp = self._fingerprint(seed)
+        info: dict = {"hits": 0, "misses": 0, "programs": {}, "bytes": 0}
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+        def fetch(name, jitted, *example):
+            key = f"{fp}-{name}"
+            exe = cc.load(key)
+            if exe is not None:
+                info["hits"] += 1
+                info["programs"][name] = "hit"
+                return exe
+            try:
+                exe = jitted.lower(
+                    *jax.tree_util.tree_map(sds, example)
+                ).compile()
+                nbytes = cc.store(key, exe, {"program": name})
+                if nbytes and cc.load(key) is None:
+                    # XLA:CPU wart (same family as tests/conftest.py's
+                    # persistent-cache notes): an executable whose compile
+                    # was satisfied from JAX's OWN persistent compilation
+                    # cache serializes to a payload missing its fused-
+                    # kernel symbols — it can never deserialize. Drop the
+                    # dead entry so warm starts don't re-fail on it; the
+                    # live executable still serves this process.
+                    cc.path_for(key).unlink(missing_ok=True)
+                    cc.path_for(key).with_suffix(".json").unlink(
+                        missing_ok=True
+                    )
+                    info["programs"][name] = "unserializable"
+                else:
+                    info["bytes"] += nbytes
+                    info["misses"] += 1
+                    info["programs"][name] = "miss"
+                return exe
+            except Exception as exc:  # exotic config: jit path serves it
+                info["programs"][name] = f"error:{type(exc).__name__}"
+                return None
+
+        s = self.pool.max_slots
+        cache_ex = self.pool.cache
+        i32 = lambda *shape: jnp.zeros(shape, jnp.int32)
+        decode_args = [
+            cache_ex, i32(s), i32(s), jnp.zeros(s, bool), i32(s),
+        ]
+        if self.paged:
+            decode_args.append(i32(s, self.pool.max_blocks))
+        decode_args += [
+            jnp.zeros(s, bool), i32(s), i32(s), jnp.zeros(s, jnp.float32),
+            i32(s), jnp.ones(s, jnp.float32), i32(s),
+        ]
+        self._decode_aot = {"exe": fetch("decode", self._decode_fn,
+                                         *decode_args)}
+        # _cache_shapes is already a ShapeDtypeStruct tree and sds() maps
+        # it through unchanged — no device-side batch-1 cache allocation
+        # just to describe shapes
+        row_ex = self.prefiller._cache_shapes
+        buckets, b = [], self.prefiller.minimum
+        while b <= self.prefiller.chunk:
+            buckets.append(b)
+            b *= 2
+        aot = {}
+        for b in buckets:
+            exe = fetch(f"pf{b}", self.prefiller._chunk_final,
+                        row_ex, i32(1, b))
+            if exe is not None:
+                aot[("final", b)] = exe
+        # body chunks are always exactly `chunk` long (only the final
+        # chunk is partial), so one body program covers them
+        exe = fetch(f"pb{self.prefiller.chunk}", self.prefiller._chunk_body,
+                    row_ex, i32(1, self.prefiller.chunk))
+        if exe is not None:
+            aot[("body", self.prefiller.chunk)] = exe
+        self.prefiller.attach_aot(aot)
+        info["build_s"] = round(time.perf_counter() - t0, 6)
+        self.compile_cache_info = info

@@ -28,7 +28,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+
 from tpudist.generate import bucket_length
+
+
+def _fresh_cursors(cache, start: int):
+    """Set every integer scalar cursor to ``start`` with ONE DISTINCT
+    device buffer per leaf. ``tpudist.generate._reset_cursors`` shares a
+    single traced scalar across all cursor leaves — correct inside a jit
+    (where it runs for the static path), but OUTSIDE one the shared
+    buffer makes the chunk programs' donation see the same buffer twice
+    and refuse to execute."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.asarray(np.asarray(start, leaf.dtype))
+        if jnp.ndim(leaf) == 0 and jnp.issubdtype(leaf.dtype, jnp.integer)
+        else leaf,
+        cache,
+    )
 
 
 @jax.jit
@@ -88,8 +104,33 @@ class Prefiller:
 
         self._chunk_body = chunk_body
         self._chunk_final = chunk_final
+        # (kind, bucket) -> AOT executable, attached by the engine's
+        # deploy-time compile cache; shapes outside the map take the jit
+        # path, and a failing executable falls back permanently
+        self._aot: dict[tuple[str, int], object] = {}
 
-    def chunk_plan(self, p: int) -> list[tuple[int, int]]:
+    def attach_aot(self, programs: dict) -> None:
+        """Route chunk programs through cached AOT executables
+        (``{("final"|"body", bucket): executable}`` — the engine's
+        ``compile_cache=`` warm-start path builds the map)."""
+        self._aot = dict(programs)
+
+    def _run_chunk(self, cache, toks, final: bool):
+        kind = "final" if final else "body"
+        exe = self._aot.get((kind, toks.shape[1]))
+        if exe is not None:
+            try:
+                return exe(cache, toks)
+            except Exception:
+                # a geometry the fingerprint couldn't see: never again —
+                # the cache may cost a trace, not a wrong program. Safe
+                # to retry on the same args because argument validation
+                # raises PRE-dispatch, before donation invalidates the
+                # chunk cache (same boundary as the engine's decode AOT)
+                self._aot.pop((kind, toks.shape[1]), None)
+        return (self._chunk_final if final else self._chunk_body)(cache, toks)
+
+    def chunk_plan(self, p: int, start: int = 0) -> list[tuple[int, int]]:
         """The ``(real, padded)`` chunk lengths a ``p``-token prompt runs
         as (full chunks, then the remainder's bucket) — the ONE place the
         split is computed (``__call__`` iterates it), exposed so tests can
@@ -99,8 +140,11 @@ class Prefiller:
         bucket on a near-full prompt would write past the cache end —
         dynamic_update_slice clamps the start, misaligning the prefix K/V
         silently (the cap is always >= the real length because the prompt
-        itself fits the cache)."""
-        plan, off = [], 0
+        itself fits the cache). ``start`` plans only the SUFFIX
+        ``tokens[start:]`` — the prefix-cache hit path
+        (:meth:`resume`), where the first ``start`` tokens' K/V arrive
+        from shared pool blocks and never re-run."""
+        plan, off = [], start
         while off < p:
             n = min(self.chunk, p - off)
             plan.append((n, bucket_length(
@@ -111,25 +155,41 @@ class Prefiller:
         return plan
 
     def __call__(self, prompt):
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
+        )
+        return self.resume(cache, prompt, 0)
+
+    def resume(self, cache, prompt, start: int):
+        """Prefill only ``prompt[start:]`` against a batch-1 cache whose
+        K/V already hold positions ``[0, start)`` — the prefix-cache hit
+        path (``tpudist.serve.blocks``): the shared blocks are gathered
+        into the contiguous view, the cursors rewind to ``start``, and
+        the model forward runs for the suffix alone (TTFT for a cache-hit
+        admission drops to ~one chunk). ``start=0`` with a fresh cache is
+        exactly ``__call__``. ``start`` must be < len(prompt): the last
+        prompt token always re-runs so the final chunk yields its logits
+        (the first sampled position)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.shape[0]
         if not 0 < p <= self.model.max_seq_len:
             raise ValueError(
                 f"prompt length {p} outside (0, {self.model.max_seq_len}]"
             )
-        cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
-        )
-        plan = self.chunk_plan(p)
-        off, logits, last = 0, None, 0
+        if not 0 <= start < p:
+            raise ValueError(f"resume start {start} outside [0, {p})")
+        if start:
+            cache = _fresh_cursors(cache, start)
+        plan = self.chunk_plan(p, start)
+        off, logits, last = start, None, 0
         for i, (n, padded) in enumerate(plan):
             toks = np.zeros((1, padded), np.int32)
             toks[0, :n] = prompt[off : off + n]
             toks = jnp.asarray(toks)
             if i + 1 < len(plan):
-                cache = self._chunk_body(cache, toks)
+                cache = self._run_chunk(cache, toks, final=False)
             else:
-                cache, logits = self._chunk_final(cache, toks)
+                cache, logits = self._run_chunk(cache, toks, final=True)
             off += n
             last = n - 1
         # NOTE on the cursor: after a padded final chunk the cache's scalar

@@ -87,6 +87,14 @@ class SlotPool:
 
     @property
     def utilization(self) -> float:
+        """Active-slot fraction. On THIS (contiguous) pool slots and bytes
+        are the same resource, so this is also byte occupancy; under paged
+        admission that identity breaks, and
+        :class:`tpudist.serve.blocks.PagedSlotPool` overrides this
+        property to report BLOCK-pool occupancy instead (a slot-count
+        reading there overstates free capacity — the `serve` rows keep
+        `slot_utilization` with the slot-count meaning and carry
+        `pool_occupancy` separately; docs/OBSERVABILITY.md §1)."""
         return self.n_active / self.max_slots
 
     def insert(self, row_cache, true_len: int) -> int:

@@ -52,15 +52,23 @@ class ServeStats:
     and the notebook path read :meth:`snapshot` directly)."""
 
     def __init__(self, *, slots: int, sink=None, every: int = 50,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, paged: bool = False):
         self.slots = slots
         self.sink = sink
         self.every = max(int(every), 0)
         self._clock = clock
+        self.paged = paged
         self.t_start = clock()
         self.submitted = 0
         self.completed = 0
         self.tokens = 0
+        # paged-pool telemetry (zero/None on a contiguous engine): the
+        # engine drives on_preempt / on_prefix; pool occupancy rides each
+        # on_tick so the serve row shows the live block budget
+        self.preemptions = 0
+        self._prefix_hit_blocks = 0
+        self._prefix_lookup_blocks = 0
+        self._pool_occupancy: float | None = None
         self.ttft: collections.deque[float] = collections.deque(
             maxlen=SLO_WINDOW
         )
@@ -80,9 +88,13 @@ class ServeStats:
 
     # -- per-request lifecycle --------------------------------------------
 
-    def on_submit(self, request_id: int) -> None:
+    def on_submit(self, request_id: int) -> float:
+        """Returns the arrival timestamp so the engine's TTFT-SLO aging
+        runs on the same clock reading TTFT is measured against."""
         self.submitted += 1
-        self._arrival[request_id] = self._clock()
+        t = self._clock()
+        self._arrival[request_id] = t
+        return t
 
     def on_first_token(self, request_id: int) -> None:
         t = self._clock()
@@ -99,6 +111,24 @@ class ServeStats:
         if first is not None and n_tokens > 1:
             self.tpot.append((self._clock() - first) / (n_tokens - 1))
 
+    def on_preempt(self, request_id: int) -> None:
+        """A live request was evicted back to the queue (pool ran dry);
+        its blocks freed, its prompt+progress replay at re-admission."""
+        self.preemptions += 1
+
+    def on_prefix(self, hit_blocks: int, lookup_blocks: int) -> None:
+        """One admission's prefix-cache outcome, in BLOCK units (hit rate
+        = hit blocks / full prompt blocks looked up — token-weighted, so
+        one long shared system prompt counts for what it saves)."""
+        self._prefix_hit_blocks += hit_blocks
+        self._prefix_lookup_blocks += lookup_blocks
+
+    @property
+    def prefix_hit_rate(self) -> float | None:
+        if not self._prefix_lookup_blocks:
+            return None
+        return round(self._prefix_hit_blocks / self._prefix_lookup_blocks, 4)
+
     # -- per-step drive ----------------------------------------------------
 
     def on_decode_step(self, active: int, emitted: int) -> None:
@@ -109,7 +139,9 @@ class ServeStats:
         self._life_active += active
         self._life_steps += 1
 
-    def on_tick(self, step: int, *, queue_depth: int, active: int) -> None:
+    def on_tick(self, step: int, *, queue_depth: int, active: int,
+                pool_occupancy: float | None = None) -> None:
+        self._pool_occupancy = pool_occupancy
         if self.sink is None or not self.every or step % self.every:
             return
         self.sink.write("serve", step, **self._window_row(queue_depth, active))
@@ -135,6 +167,18 @@ class ServeStats:
             "ttft_p95": _pct(self.ttft, 95),
             "tpot_p50": _pct(self.tpot, 50),
             "tpot_p95": _pct(self.tpot, 95),
+            # paged-pool fields (docs/OBSERVABILITY.md §1): block-pool
+            # occupancy (null on a contiguous engine, where
+            # slot_utilization above IS the capacity truth — under paged
+            # admission it keeps its slot-count meaning but no longer
+            # measures free bytes), prefix-cache hit rate (block-
+            # weighted, null before any lookup), lifetime preempt count
+            "pool_occupancy": (
+                None if self._pool_occupancy is None
+                else round(self._pool_occupancy, 4)
+            ),
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "preemptions": self.preemptions,
         }
 
     def snapshot(self) -> dict:
@@ -154,6 +198,12 @@ class ServeStats:
             "ttft_p95": _pct(self.ttft, 95),
             "tpot_p50": _pct(self.tpot, 50),
             "tpot_p95": _pct(self.tpot, 95),
+            "pool_occupancy": (
+                None if self._pool_occupancy is None
+                else round(self._pool_occupancy, 4)
+            ),
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "preemptions": self.preemptions,
         }
 
     def write_summary(self, step: int) -> None:

@@ -178,7 +178,9 @@ class TelemetrySink:
     action — cause, rollback step, skipped window, action taken:
     ``tpudist.resilience.repair``). The serving engine
     (``tpudist.serve``) writes ``serve``/``serve_summary`` SLO rows
-    through the same sink. Schema glossary in docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
+    through the same sink — TTFT/TPOT percentiles, slot utilization,
+    and in paged mode the block-pool triple (``pool_occupancy``,
+    ``prefix_hit_rate``, ``preemptions``). Schema glossary in docs/OBSERVABILITY.md. Rows flush per write, and the file opens in
     APPEND mode — both halves of the flight-recorder contract: the anomaly
     row must survive the crash it describes, including a checkpoint-resume
     of the same job_id truncating the evidence before anyone read it.
