@@ -13,8 +13,12 @@ Launch (single host):
 
 Parallelism knobs compose on the named mesh:
 
+    --fsdp 4               params+Adam scattered over 'fsdp' (ZeRO-3-style;
+                           composes with --tensor/--pipe under a
+                           ParallelPlan — tpudist.parallel.plan)
     --tensor 4             Megatron TP over 'tensor'
-    --pipe 4 --num_micro 8 GPipe over 'pipe' (stacked blocks)
+    --pipe 4 --num_micro 8 microbatch pipelining over 'pipe' (stacked
+                           blocks; --pipe_schedule gpipe|1f1b)
     --cp 4 --attn ring     ring-attention context parallelism over 'seq'
     --experts 8            MoE blocks (every other for gpt2, every for
                            llama/Mixtral-style), experts over 'expert'
@@ -116,9 +120,22 @@ def parse_args(argv=None):
                    "50257-entry vocab)")
     p.add_argument("--synthetic_tokens", default=2_000_000, type=int)
     # parallelism (sizes of the mesh axes; data gets the rest)
+    p.add_argument("--fsdp", default=1, type=int,
+                   help="'fsdp' mesh axis size: every leaf the Megatron/"
+                   "pipe metadata leaves replicated (Adam mirrors "
+                   "included) is scattered over it and the batch splits "
+                   "over data x fsdp — the composed run goes through a "
+                   "ParallelPlan (tpudist.parallel.plan)")
     p.add_argument("--tensor", default=1, type=int)
     p.add_argument("--pipe", default=1, type=int)
     p.add_argument("--num_micro", default=8, type=int)
+    p.add_argument("--pipe_schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="microbatch schedule for --pipe (tpudist.parallel"
+                   ".pp): gpipe = reverse-mode through the forward scan; "
+                   "1f1b = explicit one-forward-one-backward backward "
+                   "ring — same math, stage internals recomputed instead "
+                   "of stored (the deep-pipeline activation lever)")
     p.add_argument("--cp", default=1, type=int, help="'seq' (context) axis size")
     p.add_argument("--experts", default=0, type=int, help="MoE experts (0=dense)")
     p.add_argument("--expert_axis", default=0, type=int,
@@ -258,12 +275,25 @@ def main(argv=None):
         )
     else:
         expert_axis = 1
+    if args.fsdp > 1 and args.cp > 1:
+        raise SystemExit(
+            "--fsdp does not compose with --cp yet (the context-parallel "
+            "batch_spec owns the batch layout); drop one"
+        )
     mesh = mesh_lib.create_mesh(
         mesh_lib.MeshConfig(
-            data=-1, tensor=args.tensor, pipe=args.pipe, seq=args.cp,
-            expert=max(expert_axis, 1),
+            data=-1, fsdp=args.fsdp, tensor=args.tensor, pipe=args.pipe,
+            seq=args.cp, expert=max(expert_axis, 1),
         )
     )
+    # the composed-parallelism resolver (tpudist.parallel.plan): engaged
+    # when the fsdp axis is real — tensor/pipe-only runs keep the
+    # metadata path they always used (identical placements)
+    plan = None
+    if args.fsdp > 1:
+        from tpudist.parallel.plan import ParallelPlan
+
+        plan = ParallelPlan(mesh)
     dtype = jnp.bfloat16 if (args.bf16 or args.amp) else jnp.float32
 
     def build_model(scan_layers: bool, remat_layers: bool):
@@ -300,7 +330,7 @@ def main(argv=None):
                 mesh, num_micro=args.num_micro, vocab_size=args.vocab_size,
                 max_seq_len=args.seq_len, hidden_dim=args.hidden_dim,
                 depth=args.depth, num_heads=args.num_heads, dtype=dtype,
-                attn_impl=args.attn,
+                attn_impl=args.attn, schedule=args.pipe_schedule,
             )
         if args.arch == "llama":
             from tpudist.models.llama import Llama
@@ -432,7 +462,7 @@ def main(argv=None):
             )
         return fit(
             mdl, tx, loader,
-            epochs=args.epochs, mesh=mesh,
+            epochs=args.epochs, mesh=mesh, plan=plan,
             job_id=args.JobID, batch_size=args.batch_size,
             world_size=dp_size, global_rank=ctx.process_index,
             loss_fn=lm_loss, input_key="tokens", label_key="tokens",

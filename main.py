@@ -128,6 +128,12 @@ def parse_args(argv=None):
                         "scales + error feedback (the DCN-bound lever, "
                         "docs/PERF.md §11); auto = quantized on a "
                         "multi-slice attach, none otherwise")
+    parser.add_argument("--fsdp", default=1, type=int,
+                        help="'fsdp' mesh axis size (tpudist.parallel.plan)"
+                        ": params + Adam mirrors scattered over it, batch "
+                        "split over data x fsdp (ZeRO semantics — sharded "
+                        "state, DP gradients); >1 runs the whole loop "
+                        "under a ParallelPlan")
     parser.add_argument("--augment", action="store_true",
                         help="train augmentation (crop+flip+normalize); "
                         "reference default is ToTensor only. Host-side for "
@@ -333,7 +339,14 @@ def main(argv=None):
     from tpudist.train import fit
 
     ctx = init_from_env()
-    mesh = create_mesh()
+    plan = None
+    if args.fsdp > 1:
+        from tpudist.parallel.plan import ParallelPlan
+
+        plan = ParallelPlan.build(data=-1, fsdp=args.fsdp)
+        mesh = plan.mesh
+    else:
+        mesh = create_mesh()
 
     # --amp = the named policy (fp32 master params, bf16 compute) + the
     # overflow guard on the optimizer below; --bf16 alone = dtype only
@@ -544,7 +557,7 @@ def main(argv=None):
         )
     state, losses = fit(
         model, tx, loader,
-        epochs=args.epochs, mesh=mesh,
+        epochs=args.epochs, mesh=mesh, plan=plan,
         loss_fn=loss_fn,
         job_id=args.JobID,
         batch_size=args.batch_size,

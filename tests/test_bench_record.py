@@ -95,7 +95,11 @@ def test_final_line_is_compact_and_parses(bench, tmp_path, capsys):
     assert compact["metric"] == "bench_summary_compact"
     assert len(compact["legs"]) == 14
     for leg in compact["legs"].values():
-        assert set(leg) == {"value", "vs_baseline"}  # no unit prose
+        # per-leg payload is the [value, vs_baseline] PAIR (no unit
+        # prose, no per-leg keys — the keyed form broke the 2 KB bound
+        # once the real inventory passed ~24 legs)
+        assert isinstance(leg, list) and len(leg) == 2
+        assert leg == [123456.78, round(123456.78 / 100000.0, 4)]
     # sized for the tail window: every leg name + 2 floats, nothing else.
     # 14 legs of this record's real name lengths fit in well under 2 KB;
     # the full summary above it measured >5 KB.

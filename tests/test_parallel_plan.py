@@ -1,0 +1,448 @@
+"""Composable parallelism (tpudist.parallel.plan): the ParallelPlan
+resolver's composition-parity grid, the explicit-reduction refusal/route
+matrix, the elastic model-axis default-deny hints, and the plan-aware
+budget/MFU accounting — all on the emulated 8-CPU-device mesh (conftest).
+
+The correctness contract mirrors SURVEY.md §4's DP-equivalence strategy:
+every composed-mesh trajectory must match the pure-DP reference — sharding
+is placement, not math.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudist import mesh as mesh_lib
+from tpudist.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+from tpudist.models.gpt2 import GPT2
+from tpudist.parallel.plan import ParallelPlan, spec_is_sharded
+from tpudist.train import (
+    create_train_state, lm_loss, make_train_step, state_shardings_of,
+)
+
+_GPT2_CFG = dict(vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+                 num_heads=4)
+
+
+def _batches(n_steps=3, batch=8, seed=3):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    return [
+        {"tokens": rng.integers(0, 64, (batch, 16)).astype(np.int32)}
+        for _ in range(n_steps)
+    ]
+
+
+def _trajectory(plan, *, shard_opt_state=False, telemetry=False,
+                guard_nonfinite=False, n_steps=3, min_size=256):
+    """Loss trajectory of the tiny GPT-2 under ``plan`` (None = the
+    pure-DP reference on the full default mesh), same seed and batches."""
+    model = GPT2(**_GPT2_CFG)
+    tx = optax.adam(1e-3)
+    if plan is None:
+        mesh = mesh_lib.create_mesh()
+        state = create_train_state(
+            model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh
+        )
+    else:
+        mesh = plan.mesh
+        if shard_opt_state:
+            tx = plan.wrap_zero1(tx)
+        state = create_train_state(
+            model, 0, jnp.zeros((1, 16), jnp.int32), tx, plan=plan
+        )
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+        plan=plan, telemetry=telemetry, guard_nonfinite=guard_nonfinite,
+    )
+    losses = []
+    for batch in _batches(n_steps):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+        if telemetry:
+            assert np.isfinite(float(metrics["grad_norm"]))
+        if guard_nonfinite:
+            assert int(metrics["update_skipped"]) == 0
+    return losses
+
+
+def _plan(min_size=256, **axes):
+    """Plan over exactly the devices its axes ask for (the grid's cells
+    use 4 of conftest's 8 emulated devices; the reference uses all 8 —
+    the global-batch-mean math is device-count-invariant)."""
+    import math
+
+    axes.setdefault("data", 1)
+    devices = jax.devices()[: math.prod(axes.values())]
+    return ParallelPlan.build(
+        fsdp_min_size=min_size, devices=devices, **axes
+    )
+
+
+# -- the composition-parity grid ------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "axes",
+    [
+        dict(data=2, fsdp=2),
+        dict(data=2, tensor=2),
+        dict(fsdp=2, tensor=2),
+    ],
+    ids=lambda a: "x".join(f"{k}{v}" for k, v in a.items()),
+)
+def test_composed_trajectory_matches_pure_dp(axes):
+    """Each composed-mesh cell trains the SAME trajectory as the pure-DP
+    reference: the plan is placement, not math. Tolerance covers fp32
+    reduction-order drift amplified through 3 Adam steps (the established
+    bound of the fsdp/dp-equivalence suites)."""
+    want = _trajectory(None)
+    got = _trajectory(_plan(**axes))
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_composed_cell_with_zero1_telemetry_and_guard():
+    """The fully-loaded cell the acceptance names: fsdp×tensor with
+    ZeRO-1 (plan.wrap_zero1), in-step telemetry, and guard_nonfinite —
+    trajectory still pinned to the pure-DP reference."""
+    want = _trajectory(None)
+    got = _trajectory(
+        _plan(data=2, fsdp=2, tensor=2), shard_opt_state=True,
+        telemetry=True, guard_nonfinite=True,
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_plan_state_is_actually_sharded():
+    """The plan's placements are real: TP metadata kept on the qkv kernel,
+    an unannotated leaf (wpe) scattered over fsdp, and the Adam mirrors
+    follow their params."""
+    plan = _plan(data=2, fsdp=2, tensor=2)
+    model = GPT2(**_GPT2_CFG)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 16), jnp.int32), tx, plan=plan
+    )
+    qkv = state.params["h_0"]["qkv"]["kernel"].sharding.spec
+    assert TENSOR_AXIS in tuple(qkv), qkv
+    wpe = state.params["wpe"].sharding.spec
+    assert FSDP_AXIS in tuple(wpe), wpe
+    mu_wpe = state.opt_state[0].mu["wpe"].sharding.spec
+    assert FSDP_AXIS in tuple(mu_wpe), mu_wpe
+    mu_qkv = state.opt_state[0].mu["h_0"]["qkv"]["kernel"].sharding.spec
+    assert TENSOR_AXIS in tuple(mu_qkv), mu_qkv
+    # memory really drops: the fsdp-scattered leaf lives at 1/2 per chip
+    local = state.params["wpe"].addressable_shards[0].data
+    assert local.size * 2 == state.params["wpe"].size
+
+
+def test_wrap_zero1_skips_fsdp_leaves():
+    """No double-sharding: a leaf the plan fsdp-scatters keeps its natural
+    shape (skipped by ZeRO-1); a leaf with no fsdp-divisible dim still
+    gets the pad-and-reshape data layout."""
+    plan = _plan(data=2, fsdp=2)
+    tx = plan.wrap_zero1(optax.scale_by_adam())
+    params = {
+        "fsdpable": jnp.zeros((2048, 3)),  # fsdp-divisible dim -> skipped
+        "padme": jnp.zeros((3, 343)),      # 1029 elems, nothing divides
+    }
+    state = tx.init(params)
+    assert state.mu["fsdpable"].shape == (2048, 3)
+    assert state.mu["padme"].shape == (2, 515)  # [data_world, cols] pad
+    sh = tx.state_shardings(params)
+    assert sh.mu["padme"].spec == P(DATA_AXIS, None)
+    assert not spec_is_sharded(sh.mu["fsdpable"].spec, plan.mesh)
+    # ...and the plan's overlay gives the skipped leaf its fsdp placement
+    composed = plan.opt_state_shardings(params, tx)
+    assert FSDP_AXIS in tuple(composed.mu["fsdpable"].spec)
+    assert composed.mu["padme"].spec == P(DATA_AXIS, None)
+    # mirrors of METADATA-sharded params stay aligned with their params
+    # (tensor spec kept through the overlay — the update must never have
+    # to reshard the moments against their grads)
+    import flax.linen as nn
+
+    tp_plan = _plan(data=2, fsdp=2, tensor=2)
+    tp_tx = tp_plan.wrap_zero1(optax.scale_by_adam())
+    boxed = {
+        "qkv": nn.Partitioned(
+            jnp.zeros((2048, 8)), names=(None, TENSOR_AXIS)
+        ),
+    }
+    tp_composed = tp_plan.opt_state_shardings(boxed, tp_tx)
+    assert tp_composed.mu["qkv"].spec == P(None, TENSOR_AXIS)
+    # round-trip parity: update through the composed layout == plain adam
+    inner = optax.scale_by_adam()
+    ref_state = inner.init(params)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 0.25, p.dtype), params
+    )
+    up, _ = tx.update(grads, state, params)
+    up_ref, _ = inner.update(grads, ref_state, params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        ),
+        up, up_ref,
+    )
+
+
+# -- the refusal / route matrix -------------------------------------------
+
+
+def test_resolve_method_walks_the_data_column():
+    """resolve_method('auto') must probe the devices it actually reduces
+    over — one data-axis column, not jax.devices() — and on a COMPOSED
+    mesh it must route to the implicit path without probing at all (the
+    explicit reducer is pure-DP; even a DCN-crossing data axis can't use
+    it there, so a 'quantized' resolution would only crash bring-up)."""
+    from tpudist.parallel import dp as dp_mod
+
+    seen = {}
+    orig = dp_mod.comm.multislice_dcn
+
+    def spy(devices):
+        seen["devices"] = list(devices)
+        return orig(devices)
+
+    dp_mod.comm.multislice_dcn = spy
+    try:
+        # pure-DP sub-mesh: probe the column (coords differ on 'data' only)
+        pure = mesh_lib.create_mesh(
+            mesh_lib.MeshConfig(data=2), devices=jax.devices()[:2]
+        )
+        method = dp_mod.resolve_method("auto", pure)
+        # emulated CPU devices share a host: auto lands on the implicit path
+        assert method == "none"
+        assert seen["devices"] == [
+            pure.devices[i, 0, 0, 0, 0, 0] for i in range(2)
+        ]
+        # composed mesh: routed to "none" BEFORE any DCN probe — a
+        # multi-slice data axis must not resolve to the (pure-DP-only)
+        # quantized reducer and crash bring-up
+        seen.clear()
+        composed = mesh_lib.create_mesh(
+            mesh_lib.MeshConfig(data=2, pipe=2, tensor=2)
+        )
+        assert dp_mod.resolve_method("auto", composed) == "none"
+        assert not seen
+    finally:
+        dp_mod.comm.multislice_dcn = orig
+
+
+def test_resolve_method_single_replica_is_none():
+    from tpudist.parallel import dp as dp_mod
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=1, fsdp=4, tensor=2))
+    assert dp_mod.resolve_method("auto", mesh) == "none"
+    assert dp_mod.resolve_method("quantized", mesh) == "none"
+
+
+def test_reducer_refuses_fsdp_mesh_naming_the_fix():
+    from tpudist.parallel.dp import GradReducer
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=4, fsdp=2))
+    with pytest.raises(ValueError) as e:
+        GradReducer(mesh, "quantized")
+    msg = str(e.value)
+    assert "'data' axis only" in msg
+    assert "reduce='none'" in msg and "MeshConfig(data=-1" in msg
+
+
+def test_plan_routes_reduce():
+    """The route half of the matrix: 'none'/'auto' pass on any plan (auto
+    resolves against the data column); explicit requests on a composed
+    plan refuse naming the fix."""
+    composed = _plan(data=2, fsdp=2, tensor=2)
+    composed.validate_reduce("none")
+    composed.validate_reduce("auto")
+    pure = ParallelPlan.build(data=-1)
+    pure.validate_reduce("quantized")  # pure DP: explicit is legal
+    for method in ("bucketed", "quantized"):
+        with pytest.raises(ValueError) as e:
+            composed.validate_reduce(method)
+        msg = str(e.value)
+        assert "'data' axis only" in msg
+        assert "fsdp=2" in msg and "tensor=2" in msg
+        assert "reduce='none'" in msg
+
+
+def test_make_train_step_plan_validation():
+    plan = _plan(data=2, fsdp=2, tensor=2)
+    model = GPT2(**_GPT2_CFG)
+    tx = optax.adam(1e-3)
+    # missing state_sharding: the replicated default would un-shard the plan
+    with pytest.raises(ValueError, match="state_sharding"):
+        make_train_step(
+            model, tx, plan.mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", plan=plan,
+        )
+    # explicit reduce on a composed plan: routed refusal, fix named
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 16), jnp.int32), tx, plan=plan
+    )
+    with pytest.raises(ValueError, match="data.*axis only"):
+        make_train_step(
+            model, tx, plan.mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", plan=plan,
+            state_sharding=state_shardings_of(state), reduce="bucketed",
+        )
+    # mismatched mesh: the plan must describe the step's mesh
+    other = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=-1))
+    with pytest.raises(ValueError, match="different mesh"):
+        make_train_step(
+            model, tx, other, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", plan=plan,
+            state_sharding=state_shardings_of(state),
+        )
+
+
+# -- elastic model-axis default-deny --------------------------------------
+
+
+def test_elastic_denies_model_axis_resize_with_hint():
+    from tpudist.resilience import elastic
+
+    saved = {"world_size": 8, "steps_per_epoch": 10, "batch_size": 4,
+             "grad_accum": 1, "fsdp_world": 2, "tensor_world": 2,
+             "pipe_world": 1}
+    run = dict(saved, fsdp_world=4)
+    reason = elastic.refusal_reason(saved, run)
+    assert reason is not None
+    assert "fsdp_world 2 -> 4" in reason
+    assert "only the data axis is elastic" in reason
+    assert "MeshConfig(fsdp=2, tensor=2, pipe=1)" in reason
+    assert not elastic.elastic_mismatch(saved, run)
+
+
+def test_elastic_legacy_meta_defaults_model_axes_to_one():
+    from tpudist.resilience import elastic
+
+    legacy = {"world_size": 8, "steps_per_epoch": 10, "batch_size": 4,
+              "grad_accum": 1}
+    # unchanged hardware, axes all 1: the appended keys compare equal
+    run_same = dict(legacy, fsdp_world=1, tensor_world=1, pipe_world=1)
+    assert elastic.meta_matches(legacy, run_same)
+    # pure data resize vs a legacy meta: still a VALID elastic resize
+    run_resize = dict(run_same, world_size=4, steps_per_epoch=20)
+    assert elastic.refusal_reason(legacy, run_resize) is None
+    assert elastic.elastic_mismatch(legacy, run_resize)
+    # a legacy checkpoint resumed onto a model-split mesh: default-denied
+    run_split = dict(run_same, fsdp_world=2)
+    reason = elastic.refusal_reason(legacy, run_split)
+    assert reason is not None and "fsdp_world 1 -> 2" in reason
+
+
+def test_fit_records_axis_worlds_in_checkpoint_meta(tmp_path):
+    """run_meta carries the plan's axis worlds end-to-end: written at
+    save, enforced at resume (a tensor-split relaunch refuses with the
+    precise hint)."""
+    import json
+    import pathlib
+
+    import optax as _optax
+
+    from tpudist.data.loader import DataLoader
+    from tpudist.train import fit
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    loader = DataLoader(
+        {"tokens": rng.integers(0, 64, (32, 16)).astype(np.int32)}, 16
+    )
+    model = GPT2(**_GPT2_CFG)
+    plan = _plan(data=4, fsdp=2)
+    fit(
+        model, _optax.adam(1e-3), loader, epochs=1, plan=plan, job_id="PW",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path),
+        checkpoint_dir=str(tmp_path / "ckpt"), profile=False,
+    )
+    meta = json.loads(
+        pathlib.Path(tmp_path / "ckpt" / "tpudist_meta.json").read_text()
+    )
+    assert meta["fsdp_world"] == 2
+    assert meta["tensor_world"] == 1 and meta["pipe_world"] == 1
+    # resume on a different MODEL-axis split: default-denied, hint names it
+    with pytest.raises(ValueError, match="only the data axis is elastic"):
+        fit(
+            model, _optax.adam(1e-3), loader, epochs=1,
+            plan=_plan(data=4, tensor=2), job_id="PW2",
+            batch_size=16, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", log_dir=str(tmp_path),
+            checkpoint_dir=str(tmp_path / "ckpt"), elastic=True,
+            profile=False,
+        )
+
+
+# -- plan-aware accounting -------------------------------------------------
+
+
+def test_mfu_divides_by_full_mesh_chips():
+    from tpudist.telemetry import flops
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=2, pipe=2, tensor=2))
+    assert flops.mesh_chips(mesh) == 8
+    # per-chip FLOPs is total/chips regardless of which axes split the
+    # model: an 8-chip composed mesh reports 1/8 the single-chip MFU at
+    # equal step time — never the whole-model-per-chip number
+    one = flops.mfu(1e12, 1.0, peak=1e12, n_chips=1)
+    composed = flops.mfu(1e12, 1.0, peak=1e12, n_chips=flops.mesh_chips(mesh))
+    assert one == pytest.approx(8 * composed)
+
+
+def test_pipelined_gpt2_advertises_flops_counter():
+    from tpudist.models.gpt2 import PipelinedGPT2
+    from tpudist.telemetry import flops
+
+    mesh = mesh_lib.create_mesh(mesh_lib.MeshConfig(data=4, pipe=2))
+    piped = PipelinedGPT2(mesh, num_micro=4, **_GPT2_CFG)
+    plain = GPT2(**_GPT2_CFG)
+    batch = {"tokens": np.zeros((8, 16), np.int32)}
+    got = flops.train_step_flops(piped, batch)
+    want = flops.train_step_flops(plain, batch)
+    assert got is not None and got == want
+
+
+def test_train_state_budget_accepts_plan():
+    from tpudist.memory import train_state_budget
+
+    model = GPT2(vocab_size=256, max_seq_len=64, hidden_dim=128, depth=4,
+                 num_heads=4)
+    tx = optax.adam(1e-3)
+    sample = np.zeros((1, 64), np.int32)
+    repl = train_state_budget(model, tx, sample, batch=8, seq=64)
+    plan = _plan(data=2, fsdp=2, tensor=2)
+    sharded = train_state_budget(
+        model, plan.wrap_zero1(tx), sample, batch=8, seq=64, plan=plan,
+    )
+    assert sharded["fsdp_world"] == 2 and sharded["tensor_world"] == 2
+    # the plan's table is genuinely per-chip: every sharded component
+    # (and the total) is smaller than the replicated accounting
+    assert sharded["params_bytes"] < repl["params_bytes"]
+    assert (sharded["opt_state_bytes_per_chip"]
+            < repl["opt_state_bytes_per_chip"])
+    assert sharded["per_chip_total_bytes"] < repl["per_chip_total_bytes"]
+    assert sharded["params_bytes_global"] == repl["params_bytes"]
+
+
+def test_marker_audit_covers_the_world_drill_module():
+    """The cross-world drill lives in its own slow-marked module
+    (test_parallel_plan_world.py — the audit's world rule is
+    file-granular): the tier-1 marker audit's emulate-world env pattern
+    must see that file as world-spawning so an unmarked drill can never
+    creep into the 870 s window."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import marker_audit
+
+    world_file = os.path.join(
+        os.path.dirname(__file__), "test_parallel_plan_world.py"
+    )
+    assert marker_audit.spawns_world(open(world_file).read())
+    # ...and THIS module must stay clean of spawn strings, or every fast
+    # in-process test here would be flagged
+    assert not marker_audit.spawns_world(open(__file__).read())

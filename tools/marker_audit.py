@@ -72,11 +72,17 @@ def offenders(records, budget: float) -> list[tuple[str, float]]:
 # the raw XLA flag (the elastic drills relaunch children at a DIFFERENT
 # device count this way, bypassing the launcher). Checked against the
 # test FILE's source — a world is spawned from module-level harness
-# strings as often as from the test body.
+# strings as often as from the test body. TPUDIST_EMULATE_WORLD is the
+# composition drills' env-indirect spelling
+# (tests/test_parallel_plan_world.py hands the child its device count
+# through the env and the child expands it to the XLA flag): the parent
+# file may then never contain the raw flag string, and an unmarked
+# multi-world drill would slip the audit.
 WORLD_PATTERNS = (
     "tpudist.launch",
     "--emulate-devices",
     "xla_force_host_platform_device_count",
+    "TPUDIST_EMULATE_WORLD",
 )
 
 

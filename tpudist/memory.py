@@ -65,9 +65,37 @@ def per_device_bytes(tree, shardings=None) -> int:
     for x, s in zip(leaves, shard_leaves):
         shape = tuple(np.shape(x)) if not hasattr(x, "shape") else tuple(x.shape)
         if s is not None and hasattr(s, "shard_shape"):
-            shape = s.shard_shape(shape)
+            try:
+                shape = s.shard_shape(shape)
+            except ValueError:
+                # an indivisible dim (e.g. an unpadded vocab under a
+                # tensor split): jax refuses the placement at runtime,
+                # but the BUDGET question "what would one chip hold" is
+                # still answerable — ceil per dim, the padded shard the
+                # allocator would reserve
+                shape = _ceil_shard_shape(shape, s)
         total += int(np.prod(shape, dtype=np.int64)) * np.dtype(x.dtype).itemsize
     return total
+
+
+def _ceil_shard_shape(shape, sharding) -> tuple:
+    """Ceil-division per-device shard shape from a NamedSharding's spec —
+    the fallback for dims the mesh axes don't divide evenly."""
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return shape
+    out = list(shape)
+    for i, part in enumerate(spec):
+        if part is None or i >= len(out):
+            continue
+        names = part if isinstance(part, tuple) else (part,)
+        factor = 1
+        for name in names:
+            if name is not None:
+                factor *= int(mesh.shape[name])
+        out[i] = -(-out[i] // factor)
+    return tuple(out)
 
 
 def state_bytes(state, shardings=None) -> dict[str, dict[str, int]]:
@@ -162,6 +190,7 @@ def train_state_budget(
     grad_dtype_bytes: int = 4,
     hbm_budget_bytes: int = 16 * 1024**3,
     workspace_fraction: float = 0.08,
+    plan=None,
 ) -> dict[str, Any]:
     """The pre-compile fits-or-not report for one LM training config.
 
@@ -178,27 +207,63 @@ def train_state_budget(
     Returns a dict with per-component bytes (global and per-chip), the
     per-chip total, ``fits`` against ``hbm_budget_bytes``, and
     ``bytes_per_param`` — the budget-table row docs/PERF.md §10 prints.
+
+    ``plan`` (:class:`tpudist.parallel.plan.ParallelPlan`) makes the
+    whole table PER-CHIP under the composed placement: params and
+    gradients count their largest single-chip shard (the plan's resolved
+    metadata+fsdp shardings — exact, from the same ``eval_shape``),
+    opt-state follows the plan's ZeRO-1 overlay (pass the
+    ``plan.wrap_zero1``-wrapped ``tx``), and the activation ESTIMATE is
+    scaled by the plan's axes (batch over ``data×fsdp``, depth over
+    ``pipe``, block internals over ``tensor`` — coarse like the base
+    estimate, labeled as one). This is the pre-compile answer to "does
+    this geometry fit ONLY under the plan?" — the ``parallel3d`` bench
+    leg prints both sides.
     """
     import jax.numpy as jnp
 
-    params_shapes = jax.eval_shape(
+    # boxed init so the plan can read the Megatron/pipe metadata; tree
+    # math sees through the boxes, so the plan-less path is unchanged
+    params_boxed = jax.eval_shape(
         lambda: model.init(
             jax.random.key(0), jnp.asarray(sample_input), train=False
         )["params"]
     )
+    from flax import linen as nn
+
+    params_shapes = nn.meta.unbox(params_boxed)
     n_params = tree_size(params_shapes)
-    params_bytes = tree_bytes(params_shapes)
+    params_global = tree_bytes(params_shapes)
+    params_bytes = params_global
+    if plan is not None:
+        params_bytes = per_device_bytes(
+            params_shapes, plan.shardings(params_boxed)
+        )
     opt_shapes = jax.eval_shape(tx.init, params_shapes)
     opt_global = tree_bytes(opt_shapes)
-    if hasattr(tx, "state_shardings"):
+    if plan is not None:
+        opt_per_chip = per_device_bytes(
+            opt_shapes, plan.opt_state_shardings(params_boxed, tx)
+        )
+    elif hasattr(tx, "state_shardings"):
         opt_per_chip = per_device_bytes(
             opt_shapes, tx.state_shardings(params_shapes)
         )
     else:
         opt_per_chip = opt_global
+    depth = int(getattr(model, "depth", 0) or 0)
+    act_batch, act_depth, act_div = batch, depth, 1
+    if plan is not None:
+        # per-chip activation scaling, coarse by construction: each chip
+        # sees batch/(data·fsdp) rows, depth/pipe layers, and 1/tensor of
+        # every block-internal (qkv/ffn activations shard with their
+        # kernels' output dims)
+        act_batch = max(batch // (plan.data * plan.fsdp), 1)
+        act_depth = max(-(-depth // plan.pipe), 1) if depth else depth
+        act_div = plan.tensor
     acts = transformer_activation_bytes(
-        batch, seq, int(getattr(model, "hidden_dim", 0) or 0),
-        int(getattr(model, "depth", 0) or 0),
+        act_batch, seq, int(getattr(model, "hidden_dim", 0) or 0),
+        act_depth,
         num_heads=getattr(model, "num_heads", None),
         remat_policy=remat_policy,
         # "auto" may dispatch to the XLA path (shape-dependent), so it
@@ -206,11 +271,16 @@ def train_state_budget(
         # direction for a fits verdict; only an explicit kernel choice
         # (vmem/flash, which never materialize scores) drops the term
         attention_scores=getattr(model, "attn_impl", "xla") in ("xla", "auto"),
-    )
+    ) // max(act_div, 1)
+    # gradients are params-shaped transients: under a plan they live at
+    # the params' sharded footprint (GSPMD reduce-scatters them), scaled
+    # from the sharded params ratio so mixed fp32/bf16 trees stay honest
     grads = n_params * grad_dtype_bytes
+    if plan is not None and params_global:
+        grads = int(grads * params_bytes / params_global)
     subtotal = params_bytes + opt_per_chip + acts + grads
     per_chip_total = int(subtotal * (1.0 + workspace_fraction))
-    return {
+    out = {
         "n_params": int(n_params),
         "world_size": int(world_size),
         "remat_policy": str(remat_policy),
@@ -225,6 +295,11 @@ def train_state_budget(
         "fits": bool(per_chip_total <= hbm_budget_bytes),
         "bytes_per_param": round(per_chip_total / max(n_params, 1), 2),
     }
+    if plan is not None:
+        out["params_bytes_global"] = int(params_global)
+        out["plan"] = plan.describe()
+        out.update(plan.axis_worlds())
+    return out
 
 
 def format_budget(report: Mapping[str, Any]) -> str:

@@ -494,6 +494,11 @@ class PipelinedGPT2:
 
     Embedding/head stay outside the pipeline (computed replicated over
     ``pipe``) — standard for shallow heads; the depth is where the memory is.
+
+    ``schedule`` selects the microbatch schedule (``tpudist.parallel.pp``):
+    ``"gpipe"`` (default) or ``"1f1b"`` — same function and gradients,
+    different backward memory profile (1F1B banks stage inputs and
+    recomputes internals in its interleaved backward ring).
     """
 
     def __init__(
@@ -508,6 +513,7 @@ class PipelinedGPT2:
         num_heads: int = 12,
         dtype: Any = jnp.float32,
         attn_impl: str = "xla",
+        schedule: str = "gpipe",
     ):
         if depth % mesh.shape[PIPELINE_AXIS]:
             raise ValueError(
@@ -522,13 +528,21 @@ class PipelinedGPT2:
                 "schedule yet; the pipelined model runs XLA attention "
                 "(attn_impl='xla')"
             )
+        from tpudist.parallel.pp import SCHEDULES
+
+        if schedule not in SCHEDULES:
+            raise ValueError(
+                f"schedule must be one of {SCHEDULES}, got {schedule!r}"
+            )
         self.mesh = mesh
         self.num_micro = num_micro
         self.vocab_size = vocab_size
         self.max_seq_len = max_seq_len
         self.hidden_dim = hidden_dim
         self.depth = depth
+        self.num_heads = num_heads
         self.dtype = dtype
+        self.schedule = schedule
         # the unrolled twin: the source of init (same seed -> same function)
         self.unrolled = GPT2(
             vocab_size=vocab_size, max_seq_len=max_seq_len,
@@ -539,6 +553,15 @@ class PipelinedGPT2:
         # initializers never run — params arrive pre-boxed from the
         # conversion), so tp=False keeps the module free of boxing logic
         self.block = Block(num_heads, dtype=dtype, attn_impl=attn_impl, tp=False)
+
+    @property
+    def flops_counter(self) -> str:
+        """Same analytic family as the unrolled twin (it IS the same
+        function): pipelining is an execution schedule, and the MFU
+        numerator must not vanish just because the depth moved onto the
+        ``pipe`` axis — telemetry divides by the mesh's FULL chip count
+        (``tpudist.telemetry.flops``)."""
+        return "gpt2"
 
     def init(self, rng, tokens, train: bool = False):
         return stack_gpt2_params(
@@ -554,7 +577,8 @@ class PipelinedGPT2:
             return self.block.apply({"params": bp}, h)
 
         x = pipeline_apply(
-            block_fn, p["blocks"], x, self.mesh, num_micro=self.num_micro
+            block_fn, p["blocks"], x, self.mesh, num_micro=self.num_micro,
+            schedule=self.schedule,
         )
         # same module (and epsilon) as plain GPT2's ln_f
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype).apply({"params": p["ln_f"]}, x)

@@ -269,6 +269,7 @@ def shard_state(
     *,
     axis: str = DATA_AXIS,
     min_size: int = 1024,
+    skip_spec: "Callable[[tuple], bool] | None" = None,
 ) -> ShardedStateOptimizer:
     """Shard ``tx``'s state across the ``axis`` (default ``data``) replicas.
 
@@ -300,8 +301,20 @@ def shard_state(
     Checkpoints hold the stored (sharded/padded) layout; resuming needs the
     same world size, which the geometry guard in ``fit()`` already
     enforces.
+
+    ``skip_spec(shape) -> bool`` exempts leaves from the ZeRO layout
+    entirely (stored natural, classified ``replicate`` here) — the
+    composition hook ``tpudist.parallel.plan.ParallelPlan.wrap_zero1``
+    uses so leaves the plan scatters over ``fsdp`` are never flattened
+    into the pad-and-reshape layout out from under their fsdp spec
+    (sharded state either way, no double-sharding).
     """
     world = int(mesh.shape[axis])
+
+    def _layout(shape):
+        if skip_spec is not None and skip_spec(tuple(shape)):
+            return ("replicate", None)
+        return _zero1_layout(shape, world, min_size)
 
     def _unbox(tree):
         # create_train_state runs init on flax-BOXED params; the ZeRO
@@ -320,14 +333,14 @@ def shard_state(
         return jax.eval_shape(tx.init, _unbox(params))
 
     def _store(leaf, ref):
-        mode, cols = _zero1_layout(ref.shape, world, min_size)
+        mode, cols = _layout(ref.shape)
         if mode != "pad":
             return leaf
         flat = jnp.ravel(leaf)
         return jnp.pad(flat, (0, world * cols - flat.size)).reshape(world, cols)
 
     def _restore(leaf, ref):
-        mode, _ = _zero1_layout(ref.shape, world, min_size)
+        mode, _ = _layout(ref.shape)
         if mode != "pad":
             return leaf
         return jnp.ravel(leaf)[: math.prod(ref.shape)].reshape(ref.shape)
@@ -357,7 +370,7 @@ def shard_state(
         so automatically when it sees this attribute)."""
 
         def sharding(ref):
-            mode, _ = _zero1_layout(ref.shape, world, min_size)
+            mode, _ = _layout(ref.shape)
             if mode == "replicate":
                 return NamedSharding(mesh, P())
             if mode == "pad":

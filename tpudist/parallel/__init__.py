@@ -14,11 +14,13 @@ strategies BEYOND parity (tp/pp/cp/ep/fsdp) over the mesh's extra axes.
 from tpudist.parallel.dp import GradReducer, make_reducer, resolve_method
 from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch
 from tpudist.parallel.fsdp import fsdp_shardings, shard_state
+from tpudist.parallel.plan import ParallelPlan, spec_is_sharded
 from tpudist.parallel.pp import pipeline_apply, stacked_param_shardings
 
 __all__ = [
     "GradReducer", "make_reducer", "resolve_method",
     "fsdp_shardings", "shard_state",
+    "ParallelPlan", "spec_is_sharded",
     "pipeline_apply", "stacked_param_shardings",
     "MoEMlp", "expert_capacity", "top_k_dispatch",
 ]

@@ -82,7 +82,19 @@ def resolve_method(method: str, mesh: Mesh) -> str:
     confined to one slice of a multi-slice attach — the other slice held
     by another job, or mapped to a model axis — reduces over ICI and must
     stay on the implicit path). A mesh with one ``data`` replica has
-    nothing to reduce: always ``"none"``."""
+    nothing to reduce: always ``"none"``.
+
+    ``"auto"`` on a mesh with ANY real non-data axis also resolves
+    ``"none"`` — routing, not refusal: the explicit reducer cannot run on
+    such a mesh anyway, axis by axis — ``fsdp`` trips the
+    replicated-params guard below, ``tensor``/``pipe``/``expert`` models
+    shard params (make_train_step's state-sharding guard), and ``seq``
+    (context-parallel) models require the ``batch_spec`` the explicit
+    path refuses — so an "auto" that resolved ``"quantized"`` there
+    would only turn bring-up into a crash. Even a DCN-crossing data axis
+    keeps the implicit GSPMD reduction on composed meshes; only an
+    EXPLICIT ``"bucketed"``/``"quantized"`` request refuses loudly (the
+    guards name the fix)."""
     if method not in METHODS:
         raise ValueError(f"reduce must be one of {METHODS}, got {method!r}")
     if int(mesh.shape[DATA_AXIS]) <= 1:
@@ -90,6 +102,12 @@ def resolve_method(method: str, mesh: Mesh) -> str:
     if method == "auto":
         import numpy as np
 
+        if any(
+            int(size) > 1
+            for name, size in mesh.shape.items()
+            if name != DATA_AXIS
+        ):
+            return "none"
         data_column = np.asarray(mesh.devices).reshape(
             int(mesh.shape[DATA_AXIS]), -1
         )[:, 0]
@@ -123,10 +141,13 @@ class GradReducer:
             )
         if int(mesh.shape[FSDP_AXIS]) != 1:
             raise ValueError(
-                "explicit gradient reduction is pure-DP: it requires "
-                f"replicated params, but the mesh has fsdp="
-                f"{int(mesh.shape[FSDP_AXIS])} — use the implicit path for "
-                "FSDP (XLA already reduce-scatters per layer there)"
+                "explicit gradient reduction is pure-DP: it reduces over "
+                "the 'data' axis only and requires replicated params, but "
+                f"the mesh has fsdp={int(mesh.shape[FSDP_AXIS])} — keep "
+                "reduce='none' (GSPMD already reduce-scatters per layer "
+                "over 'fsdp'), or move those devices to the data axis "
+                "(MeshConfig(data=-1, fsdp=1) / ParallelPlan.build("
+                "data=-1)) before asking for the explicit wire format"
             )
         self.mesh = mesh
         self.method = method

@@ -1,0 +1,347 @@
+"""ParallelPlan: ONE resolver for composed (data, fsdp, pipe, tensor) runs.
+
+No reference counterpart (SURVEY.md §2.12: the reference's only strategy is
+replicated-param DDP); built so the mesh's model axes stop being three
+separately-wired features and become one validated composition — the
+Partitioner shape of SNIPPETS.md [3], specialized to this repo's
+TrainState/mesh conventions, grounded in "Scalable Training of Language
+Models using JAX pjit and TPUv4" (PAPERS.md).
+
+What the plan resolves, from ONE walk over the model's abstract state:
+
+- **Megatron TP** — the models' existing ``nn.Partitioned`` metadata
+  (qkv/mlp_fc column-parallel, out/mlp_proj row-parallel, vocab-sharded
+  embedding) is kept verbatim; the plan never re-shards a leaf that
+  already names a real (>1) mesh axis.
+- **Stacked-block PP** — the pipelined models' ``('pipe', ...)`` boxes are
+  metadata like any other: stage placement (and the Adam mirrors') falls
+  out of the same walk.
+- **FSDP** — every leaf the metadata left replicated is scattered over
+  ``fsdp`` along its largest divisible dim (``tpudist.mesh
+  .largest_divisible_spec`` — the ONE spec rule ZeRO-1 uses over ``data``),
+  optimizer mirrors included; leaves under ``min_size`` stay replicated.
+- **ZeRO-1 composition** — :meth:`wrap_zero1` builds an
+  ``optim.shard_state`` whose layout SKIPS every leaf the plan already
+  fsdp-shards (no double-sharding: a leaf is either fsdp-scattered by the
+  plan or data-sharded/padded by ZeRO-1, never flattened out from under
+  its fsdp spec), and :meth:`state_shardings` overlays the two so the
+  state is BORN composed inside ``create_train_state``'s one compiled
+  init.
+- **Batch / rng** — the batch rides the framework's ``(data, fsdp)``
+  sharding (:func:`tpudist.mesh.batch_sharding`); the per-step dropout/SR
+  keys are derived host-side from the step counter and replicate by
+  construction, so the plan has nothing to re-place there.
+- **Explicit reduction routing** — ``make_train_step(reduce=...)``'s
+  pure-DP refusals become routing: :meth:`validate_reduce` allows the
+  explicit/quantized reducer only when the plan has no real model axis
+  (it reduces over ``data`` alone), and points at the fix otherwise;
+  composed plans keep the implicit GSPMD reduction, which already
+  reduce-scatters over ``fsdp`` and inserts the per-block ``tensor``
+  all-reduces from the param shardings.
+
+Threading: ``create_train_state(..., plan=)`` births the composed state,
+``make_train_step(..., plan=)`` validates the composition and carries it
+as ``step.plan``, ``fit(plan=...)`` does both and records the plan's axis
+worlds in the checkpoint geometry meta
+(``fsdp_world``/``tensor_world``/``pipe_world`` — old metas default 1,
+non-data resizes refuse with a precise hint,
+``tpudist.resilience.elastic``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist import mesh as mesh_lib
+from tpudist.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    PIPELINE_AXIS,
+    TENSOR_AXIS,
+    largest_divisible_spec,
+)
+
+__all__ = ["ParallelPlan", "spec_is_sharded"]
+
+
+def spec_is_sharded(spec, mesh: Mesh) -> bool:
+    """True iff ``spec`` names at least one mesh axis with >1 devices —
+    the ONE "is this leaf sharded for real" predicate (Megatron
+    annotations on size-1 axes are replication in fact)."""
+    spec = tuple(spec) if spec is not None else ()
+    for part in spec:
+        names = part if isinstance(part, tuple) else (part,)
+        for name in names:
+            if name is not None and int(mesh.shape[name]) > 1:
+                return True
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    """The resolved composition for one mesh.
+
+    Construct from an existing mesh (``ParallelPlan(mesh)``) or via
+    :meth:`build` from axis sizes. ``fsdp_min_size`` is the
+    replicate-below threshold shared with ZeRO-1 (elements)."""
+
+    mesh: Mesh
+    fsdp_min_size: int = 1024
+
+    # -- geometry ----------------------------------------------------------
+
+    @classmethod
+    def build(cls, *, data: int = -1, fsdp: int = 1, pipe: int = 1,
+              tensor: int = 1, devices=None, **kw) -> "ParallelPlan":
+        """Plan + mesh in one call — ``MeshConfig`` semantics (``-1`` =
+        all remaining devices)."""
+        mesh = mesh_lib.create_mesh(
+            mesh_lib.MeshConfig(data=data, fsdp=fsdp, pipe=pipe,
+                                tensor=tensor),
+            devices=devices,
+        )
+        return cls(mesh, **kw)
+
+    @property
+    def data(self) -> int:
+        return int(self.mesh.shape[DATA_AXIS])
+
+    @property
+    def fsdp(self) -> int:
+        return int(self.mesh.shape[FSDP_AXIS])
+
+    @property
+    def pipe(self) -> int:
+        return int(self.mesh.shape[PIPELINE_AXIS])
+
+    @property
+    def tensor(self) -> int:
+        return int(self.mesh.shape[TENSOR_AXIS])
+
+    @property
+    def n_chips(self) -> int:
+        """Every device on the mesh — the MFU denominator's chip count
+        (model axes included: per-chip FLOPs is total/chips whether a chip
+        holds the whole model or 1/(tensor·pipe) of it). Delegates to the
+        one shared implementation (``tpudist.telemetry.flops``)."""
+        from tpudist.telemetry.flops import mesh_chips
+
+        return mesh_chips(self.mesh)
+
+    @property
+    def model_axes(self) -> dict[str, int]:
+        """The real (>1) model-parallel axes of this plan."""
+        return {
+            name: size
+            for name, size in (("fsdp", self.fsdp), ("pipe", self.pipe),
+                               ("tensor", self.tensor))
+            if size > 1
+        }
+
+    def axis_worlds(self) -> dict[str, int]:
+        """The geometry-meta keys a checkpoint records for this plan —
+        the layouts (and placements) below are bound to these sizes, and
+        ``tpudist.resilience.elastic`` default-denies resizing them."""
+        return {
+            "fsdp_world": self.fsdp,
+            "tensor_world": self.tensor,
+            "pipe_world": self.pipe,
+        }
+
+    def describe(self) -> str:
+        return (
+            f"ParallelPlan(data={self.data}, fsdp={self.fsdp}, "
+            f"pipe={self.pipe}, tensor={self.tensor})"
+        )
+
+    # -- sharding resolution ----------------------------------------------
+
+    def _leaf_sharding(self, spec, shape) -> NamedSharding:
+        """Metadata-or-fsdp merge for ONE leaf: a spec naming a real axis
+        is kept verbatim (TP/PP metadata — never double-sharded); anything
+        else gets the fsdp largest-divisible scatter (replicated when the
+        axis is 1 or the leaf is small)."""
+        spec = spec if isinstance(spec, P) else P()
+        if spec_is_sharded(spec, self.mesh):
+            return NamedSharding(self.mesh, spec)
+        return NamedSharding(
+            self.mesh,
+            largest_divisible_spec(
+                tuple(np.shape(shape) if not hasattr(shape, "shape")
+                      else shape.shape),
+                FSDP_AXIS, self.fsdp, min_size=self.fsdp_min_size,
+            ),
+        )
+
+    def shardings(self, tree):
+        """Sharding tree for any (possibly ``nn.Partitioned``-boxed) value
+        or ``eval_shape`` tree: metadata kept, replicated leaves
+        fsdp-scattered. Works on params, whole TrainStates, or opt-state
+        mirrors that kept their boxes."""
+        specs = nn.get_partition_spec(tree)
+        shapes = nn.meta.unbox(tree)
+        return jax.tree_util.tree_map(
+            self._leaf_sharding, specs, shapes,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    def _zero1_skip(self, shape) -> bool:
+        """ZeRO-1 skip rule: leaves the plan fsdp-scatters keep their
+        natural shape and fsdp placement — ZeRO-1's pad-and-reshape over
+        ``data`` must not flatten them out from under it."""
+        if self.fsdp <= 1:
+            return False
+        spec = largest_divisible_spec(
+            tuple(shape), FSDP_AXIS, self.fsdp, min_size=self.fsdp_min_size
+        )
+        return any(s is not None for s in spec)
+
+    def wrap_zero1(self, tx):
+        """ZeRO-1 optimizer-state sharding composed with this plan:
+        ``optim.shard_state`` over ``data``, skipping the leaves the plan
+        already scatters over ``fsdp`` (sharded state either way, no
+        double-sharding). The returned wrapper still advertises
+        ``state_shardings``; feed the wrapped tx to
+        ``create_train_state(..., plan=self)``."""
+        from tpudist.optim import shard_state
+
+        return shard_state(
+            tx, self.mesh, min_size=self.fsdp_min_size,
+            skip_spec=self._zero1_skip if self.fsdp > 1 else None,
+        )
+
+    def opt_state_shardings(self, boxed_params, tx):
+        """Opt-state sharding tree under this plan.
+
+        A plain optax ``tx``: the mirrors are metadata+fsdp-sharded like
+        their params (``tx.init`` traced on the BOXED params so the
+        mirrors carry the same partitioning boxes). A ZeRO-1 wrapper
+        (``state_shardings`` attribute — built via :meth:`wrap_zero1`):
+        its data-axis layout wins for every leaf it stores
+        (pad/natural-shard), and the plan's fsdp scatter covers the leaves
+        it skipped.
+        """
+        params_shapes = nn.meta.unbox(boxed_params)
+        if hasattr(tx, "state_shardings"):
+            zero1 = tx.state_shardings(params_shapes)
+            stored = jax.eval_shape(tx.init, params_shapes)
+            # the wrapper's init unboxes the mirrors (pure shape math),
+            # losing their Megatron/pipe boxes — recover the metadata by
+            # tracing the INNER tx over the boxed params (same tree
+            # structure; only pad-mode leaves change stored shape, and
+            # ZeRO-1 owns those outright). Mirrors of tensor/pipe-sharded
+            # params then stay ALIGNED with their params instead of
+            # getting a shape-rule fsdp scatter the update would reshard
+            # every step.
+            specs = nn.get_partition_spec(
+                jax.eval_shape(tx.inner.init, boxed_params)
+            )
+            treedef = jax.tree_util.tree_structure(zero1)
+            out = [
+                z if spec_is_sharded(getattr(z, "spec", P()), self.mesh)
+                else self._leaf_sharding(spec, ref)
+                for z, ref, spec in zip(
+                    jax.tree_util.tree_leaves(zero1),
+                    treedef.flatten_up_to(stored),
+                    treedef.flatten_up_to(specs),
+                )
+            ]
+            return jax.tree_util.tree_unflatten(treedef, out)
+        # plain tx: trace init over the boxed params so params-shaped
+        # mirrors inherit the metadata, then merge fsdp in
+        return self.shardings(jax.eval_shape(tx.init, boxed_params))
+
+    def state_shardings(self, boxed_state_fn: Callable[[], Any], tx=None):
+        """TrainState-shaped sharding tree for ``boxed_state_fn`` (a
+        no-arg builder of the BOXED TrainState — ``create_train_state``'s
+        ``_boxed``) under this plan: params/batch-stats metadata+fsdp,
+        opt-state per :meth:`opt_state_shardings` when ``tx`` is given
+        (required for ZeRO-1 wrappers; a plain tx may pass ``None`` and
+        take the metadata path for its mirrors)."""
+        abstract = jax.eval_shape(boxed_state_fn)
+        merged = self.shardings(abstract)
+        if tx is not None and hasattr(tx, "state_shardings"):
+            merged = merged.replace(
+                opt_state=self.opt_state_shardings(abstract.params, tx)
+            )
+        return merged
+
+    def place(self, state):
+        """Re-place an EXISTING (concrete) TrainState under this plan —
+        the post-hoc sibling of the born-sharded
+        ``create_train_state(plan=)`` path. Leaves already sharded for
+        real keep their placement; returns ``(placed_state, shardings)``.
+        Note: unchanged leaves are aliased, not copied (same caveat as
+        ``fsdp.shard_state``)."""
+
+        def merge(x):
+            spec = getattr(getattr(x, "sharding", None), "spec", P())
+            if spec_is_sharded(spec, self.mesh):
+                return x.sharding
+            return self._leaf_sharding(P(), np.shape(x))
+
+        shardings = jax.tree_util.tree_map(merge, state)
+        return jax.device_put(state, shardings), shardings
+
+    # -- batch -------------------------------------------------------------
+
+    @property
+    def batch_axes(self) -> tuple[str, str]:
+        """Mesh axes the batch dim is split over — ``fsdp`` contributes
+        data parallelism (ZeRO semantics: sharded state, DP gradients)."""
+        return (DATA_AXIS, FSDP_AXIS)
+
+    def batch_sharding(self, *, extra_dims: int = 3) -> NamedSharding:
+        return mesh_lib.batch_sharding(self.mesh, extra_dims=extra_dims)
+
+    @property
+    def data_parallel_size(self) -> int:
+        return mesh_lib.data_parallel_size(self.mesh)
+
+    # -- validation --------------------------------------------------------
+
+    def validate_reduce(self, reduce) -> None:
+        """Explicit/quantized gradient reduction reduces over the
+        ``data`` axis ONLY (per-replica grads inside a data-manual
+        shard_map require replicated params). A composed plan routes to
+        the implicit GSPMD reduction instead — and an explicit request
+        must say which knob to move, not just refuse."""
+        if reduce is None or reduce in ("none", "auto"):
+            # "auto" resolves against the mesh's data column
+            # (tpudist.parallel.dp.resolve_method) and lands on the
+            # implicit path whenever the data axis stays on ICI — routing,
+            # not refusal
+            return
+        axes = self.model_axes
+        if axes:
+            moved = " * ".join(f"{k}={v}" for k, v in axes.items())
+            raise ValueError(
+                f"reduce={reduce!r} is pure-DP (the explicit bucketed/"
+                f"quantized reducer reduces over the 'data' axis only) but "
+                f"this plan shards the model over {moved} — keep "
+                "reduce='none' (GSPMD already reduce-scatters gradients "
+                "over 'fsdp' and inserts the per-block 'tensor' "
+                "all-reduces), or move those devices to the data axis "
+                f"(ParallelPlan.build(data=-1) / MeshConfig(data=-1)) "
+                "before asking for the explicit wire format"
+            )
+
+    def validate_state_sharding(self, state_sharding) -> None:
+        """A plan-built step must consume plan-resolved shardings — a
+        replicated ``state_sharding`` would silently all-gather the very
+        leaves the plan scattered."""
+        if state_sharding is None:
+            raise ValueError(
+                "make_train_step(plan=...) needs state_sharding: build "
+                "the state with create_train_state(..., plan=plan) and "
+                "pass state_shardings_of(state) (fit(plan=...) does both) "
+                "— a replicated default would all-gather every "
+                "fsdp/tensor/pipe-scattered leaf back onto each chip"
+            )

@@ -41,10 +41,29 @@ def mfu(flops_per_step: float, step_seconds: float, *,
         peak: float = DEFAULT_PEAK_FLOPS, n_chips: int = 1) -> float:
     """Fraction of aggregate peak the step achieved; 0.0 on a degenerate
     (non-positive) step time rather than a ZeroDivisionError — the same
-    coarse-clock guard as ``MetricsLogger.log_step``."""
+    coarse-clock guard as ``MetricsLogger.log_step``.
+
+    ``n_chips`` must be the FULL chip count of the mesh the program spans
+    (:func:`mesh_chips`), model axes included: the numerator is total
+    MODEL FLOPs for the global batch, so dividing by every chip is
+    correct whether each chip holds the whole model (pure DP) or
+    ``1/(tensor·pipe)`` of it (a composed plan) — per-chip work is
+    ``total/chips`` either way. Counting only the data replicas (the
+    whole-model-per-chip assumption) would overstate MFU by exactly
+    ``tensor·pipe`` on a composed mesh."""
     if step_seconds <= 0.0:
         return 0.0
     return flops_per_step / step_seconds / (peak * max(n_chips, 1))
+
+
+def mesh_chips(mesh) -> int:
+    """The MFU denominator's chip count for ``mesh``: every device the
+    compiled program spans — data, fsdp, pipe, and tensor axes alike, and
+    ONLY those (a sub-mesh on a shared attach must not divide by chips it
+    never used). ``fit()``'s telemetry, ``ParallelPlan.n_chips``, and the
+    bench legs all route through this one function so a composed-plan MFU
+    row can never disagree with a bench record about the denominator."""
+    return int(mesh.size)
 
 
 # -- decoder / encoder LM counters (per GLOBAL step: pass global tokens) ----

@@ -86,6 +86,7 @@ def create_train_state(
     sample_input,
     tx: optax.GradientTransformation,
     mesh: Mesh | None = None,
+    plan=None,
 ) -> TrainState:
     """Initialize params/opt state on the mesh.
 
@@ -104,6 +105,12 @@ def create_train_state(
     mirrors are BORN sharded inside this one compiled init — they never
     materialize replicated, not even transiently, which is what lets a
     ~1B-param state fit 16 GB HBM at bring-up.
+
+    A ``plan`` (:class:`tpudist.parallel.plan.ParallelPlan`) resolves the
+    whole composed placement instead: Megatron/pipe metadata kept, every
+    still-replicated leaf (optimizer mirrors included) scattered over
+    ``fsdp``, ZeRO-1's data-axis layout overlaid where the plan skipped —
+    the state is born 3-D/4-D sharded in the same one compiled init.
     """
     if isinstance(rng, int):
         rng = jax.random.key(rng)
@@ -125,6 +132,15 @@ def create_train_state(
     def _init():
         return nn.meta.unbox(_boxed())
 
+    if plan is not None:
+        if mesh is not None and mesh != plan.mesh:
+            raise ValueError(
+                "create_train_state got both a mesh and a plan with a "
+                "DIFFERENT mesh — build the plan over the run's mesh "
+                "(ParallelPlan(mesh)) or drop the mesh argument"
+            )
+        shardings = plan.state_shardings(_boxed, tx)
+        return jax.jit(_init, out_shardings=shardings)()
     if mesh is None:
         return jax.jit(_init)()
     shardings = state_shardings_from_meta(_boxed, mesh)
@@ -260,6 +276,7 @@ def make_train_step(
     reduce_bucket_size: int | None = None,
     error_feedback: bool = True,
     fused: str | bool | None = None,
+    plan=None,
 ):
     """Build the jit-compiled (state, batch) → (state, metrics) step.
 
@@ -325,6 +342,17 @@ def make_train_step(
     :func:`state_shardings_of`) for TP/FSDP runs where params are NOT fully
     replicated; defaults to the replicated DDP model.
 
+    ``plan`` (:class:`tpudist.parallel.plan.ParallelPlan`): the composed
+    3-D/4-D configuration this step runs under. The plan does not replace
+    ``state_sharding`` (build the state with ``create_train_state(...,
+    plan=plan)`` and pass ``state_shardings_of(state)`` — ``fit(plan=...)``
+    does both); it validates the composition loudly instead: the mesh must
+    match, the state must arrive plan-sharded, and an explicit ``reduce``
+    request on a model-sharded plan raises naming the fix (the explicit
+    reducer reduces over ``data`` only; composed plans keep the implicit
+    GSPMD reduction). Carried as ``step.plan`` for telemetry/bench
+    attribution.
+
     ``batch_spec``: per-key PartitionSpec overrides for the staged batch —
     e.g. ``{"tokens": P(('data','fsdp'), 'seq')}`` shards the sequence dim
     over the ``seq`` axis for context-parallel (ring/Ulysses) models. Keys
@@ -363,6 +391,24 @@ def make_train_step(
     """
     batch_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
+    if plan is not None:
+        # composed-parallelism validation (tpudist.parallel.plan): the
+        # plan must describe THIS mesh, the state must arrive with the
+        # plan's shardings (never the replicated default), and an explicit
+        # reduce request routes — data-axis-only, with the fix named —
+        # before the reducer's own narrower refusals fire
+        if plan.mesh != mesh:
+            raise ValueError(
+                f"make_train_step got plan {plan.describe()} over a "
+                "different mesh than the step's — build the plan over the "
+                "run's mesh (ParallelPlan(mesh))"
+            )
+        plan.validate_state_sharding(state_sharding)
+        plan.validate_reduce(
+            reduce if isinstance(reduce, str) or reduce is None
+            else getattr(reduce, "method", None)
+        )
+
     from tpudist.parallel import dp as dp_mod
 
     reducer = dp_mod.make_reducer(
@@ -399,9 +445,14 @@ def make_train_step(
             ]
             if bad:
                 raise ValueError(
-                    "reduce=... requires fully-replicated params (pure DP); "
-                    f"got param shardings {bad[:3]} — TP/FSDP models keep "
-                    "the implicit XLA reduction"
+                    "reduce=... requires fully-replicated params (the "
+                    "explicit bucketed/quantized reducer reduces over the "
+                    f"'data' axis only); got param shardings {bad[:3]} — "
+                    "keep reduce='none' (GSPMD reduce-scatters over "
+                    "fsdp/tensor in-graph), or move those devices to the "
+                    "data axis (make_train_step(plan=ParallelPlan.build("
+                    "data=-1)) / MeshConfig(data=-1)) before asking for "
+                    "the explicit wire format"
                 )
 
     fused_set = resolve_fused(fused, model, tx)
@@ -724,6 +775,7 @@ def make_train_step(
     )
     compiled.fused = fused_set
     compiled.fused_info = fused_info
+    compiled.plan = plan
     return compiled
 
 
@@ -734,6 +786,7 @@ def fit(
     *,
     epochs: int,
     mesh: Mesh | None = None,
+    plan=None,
     seed: int = 0,
     job_id: str = "Job0",
     batch_size: int | None = None,
@@ -928,16 +981,43 @@ def fit(
     models' per-block ``remat_policy``) for the full memory-discipline
     recipe — the pair is what moves the trainable-size frontier on a
     16 GB chip (docs/PERF.md §10).
+
+    ``plan`` (:class:`tpudist.parallel.plan.ParallelPlan`) runs the whole
+    loop under one composed ``(data, fsdp, pipe, tensor)`` configuration
+    (docs/PERF.md "Choosing a parallelism plan"): the state is born with
+    the plan's placements (Megatron/pipe metadata kept, replicated leaves
+    fsdp-scattered, ZeRO-1 overlaid when ``shard_opt_state=True`` — via
+    ``plan.wrap_zero1``, which never double-shards an fsdp leaf), the
+    step validates the composition loudly (explicit ``reduce`` routes to
+    the data axis only, with the fix named), checkpoint geometry meta
+    records the model-axis worlds (``fsdp_world``/``tensor_world``/
+    ``pipe_world`` — a non-data-axis resize is default-denied with a
+    precise hint, ``tpudist.resilience.elastic``), and telemetry's MFU
+    rows divide model FLOPs by the plan's FULL chip count. ``mesh`` may
+    be omitted (the plan carries it) or must match the plan's.
     """
     import itertools
 
     from tpudist.data.loader import prefetch_to_mesh
 
+    if plan is not None:
+        if mesh is not None and mesh != plan.mesh:
+            raise ValueError(
+                f"fit got both a mesh and a plan ({plan.describe()}) over "
+                "a different mesh — build the plan over the run's mesh "
+                "(ParallelPlan(mesh)) or drop the mesh argument"
+            )
+        mesh = plan.mesh
     mesh = mesh or mesh_lib.create_mesh()
     if shard_opt_state:
-        from tpudist.optim import shard_state as _zero1
+        if plan is not None:
+            # ZeRO-1 composed with the plan: skip the leaves the plan
+            # scatters over fsdp (no double-sharding — parallel/plan.py)
+            tx = plan.wrap_zero1(tx)
+        else:
+            from tpudist.optim import shard_state as _zero1
 
-        tx = _zero1(tx, mesh)
+            tx = _zero1(tx, mesh)
     world_size = world_size if world_size is not None else jax.device_count()
     global_rank = (
         global_rank if global_rank is not None else jax.process_index()
@@ -967,7 +1047,7 @@ def fit(
             (mesh_lib.data_parallel_size(mesh), *sample_in.shape[1:]),
             sample_in.dtype,
         )
-    state = create_train_state(model, seed, init_input, tx, mesh)
+    state = create_train_state(model, seed, init_input, tx, mesh, plan=plan)
     if init_params is not None:
         # warm-start (e.g. an HF checkpoint through tpudist.interop):
         # replace the random init leaf-for-leaf, keeping each leaf's mesh
@@ -1050,10 +1130,11 @@ def fit(
             input_transform=input_transform, reduce=reduce, fused=fused,
             **(tel_cfg.step_kwargs() if tel_cfg else {}),
             # keep whatever sharding create_train_state produced
-            # (replicated for plain DP, sharded for TP-annotated models)
-            # — forcing replicated here would all-gather a TP model's
-            # params on the first step
+            # (replicated for plain DP, sharded for TP-annotated models
+            # and plan-composed runs) — forcing replicated here would
+            # all-gather a TP model's params on the first step
             state_sharding=state_shardings_of(state),
+            plan=plan,
         )
 
     eff_seed = (
@@ -1079,6 +1160,14 @@ def fit(
         "batch_size": batch_size,
         "world_size": world_size,
         "grad_accum": grad_accum,
+        # the model-axis worlds the state's placements are bound to
+        # (composable-parallelism geometry): appended keys — metas
+        # written before this layer carried none and default to 1, and
+        # a NON-data-axis resize is default-denied with a precise hint
+        # (tpudist.resilience.elastic.refusal_reason)
+        "fsdp_world": int(mesh.shape[mesh_lib.FSDP_AXIS]),
+        "tensor_world": int(mesh.shape[mesh_lib.TENSOR_AXIS]),
+        "pipe_world": int(mesh.shape[mesh_lib.PIPELINE_AXIS]),
     }
     if shard_opt_state:
         # ZeRO-1 changes the opt-state LAYOUT on disk (padded [world, cols]
@@ -1271,7 +1360,7 @@ def fit(
                             " — this is a pure world resize; pass "
                             "fit(elastic=True) to reshard onto the live "
                             "mesh (docs/MULTIHOST.md)"
-                            if reason is None else ""
+                            if reason is None else f" — {reason}"
                         )
                         raise ValueError(
                             f"checkpoint at {checkpoint_dir} was written by "
@@ -1420,6 +1509,7 @@ def fit(
         ) as p:
             print("Start")
             from tpudist.telemetry import TimedIterator, build_telemetry
+            from tpudist.telemetry.flops import mesh_chips as flops_chips
 
             # sink attached BEFORE the first log_memory: the dual-sink
             # contract mirrors every logger row, including the bring-up
@@ -1428,7 +1518,13 @@ def fit(
                 tel_cfg or False,
                 job_id=job_id, log_dir=log_dir, rank=global_rank,
                 world_size=world_size, log_every=logger.log_every,
-                n_chips=jax.device_count(), profiler=p, model=model,
+                # the MESH's chip count, not jax.device_count(): the MFU
+                # denominator must count every chip the model program
+                # actually spans (tensor/pipe splits included) and ONLY
+                # those — a sub-mesh run on a shared attach would
+                # otherwise divide by chips it never used
+                n_chips=flops_chips(mesh),
+                profiler=p, model=model,
                 input_key=input_key, mesh=mesh,
             )
             if tel is not None:
