@@ -56,6 +56,15 @@ def parse_args(argv=None):
     p.add_argument("--top_k", default=0, type=int)
     p.add_argument("--top_p", default=1.0, type=float)
     p.add_argument("--eos_id", default=None, type=int)
+    p.add_argument("--spec_draft", default=0, type=int,
+                   help="speculative decoding: early-exit draft DEPTH "
+                   "(the target's first N blocks, zero extra weight HBM; "
+                   "0 = off). The draft proposes --spec_k tokens per slot "
+                   "per tick and the target verifies the window in one "
+                   "bulk pass — greedy output stays token-identical "
+                   "(docs/SERVING.md §6)")
+    p.add_argument("--spec_k", default=4, type=int,
+                   help="with --spec_draft: proposals per slot per tick")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--log_dir", default=".", type=str)
     p.add_argument("--JobID", default="Serve", type=str)
@@ -116,9 +125,18 @@ def main(argv=None):
     sink = TelemetrySink(
         os.path.join(args.log_dir, f"{args.JobID}_serve_0.jsonl")
     )
+    spec_kw = {}
+    if args.spec_draft:
+        from tpudist.serve import early_exit_draft
+
+        draft_model, draft_params = early_exit_draft(
+            model, params, args.spec_draft
+        )
+        spec_kw = dict(draft_model=draft_model, draft_params=draft_params,
+                       spec_k=args.spec_k)
     engine = ServeEngine(
         model, params, max_slots=args.slots, max_queue=args.max_queue,
-        seed=args.seed, sink=sink, stats_every=10,
+        seed=args.seed, sink=sink, stats_every=10, **spec_kw,
     )
     rids = [
         engine.submit(
@@ -145,7 +163,13 @@ def main(argv=None):
         f"TPOT p50/p95 {fmt_s(snap['tpot_p50'], 1e3, 1)}/"
         f"{fmt_s(snap['tpot_p95'], 1e3, 1)}ms, "
         f"slot utilization {fmt_s(snap['slot_utilization'], digits=2)}\n"
-        f"serve telemetry: {sink.path}"
+        + (
+            f"speculative: {snap['spec_accepted']}/{snap['spec_drafted']} "
+            "drafts accepted (rate "
+            f"{fmt_s(snap['spec_acceptance_rate'], digits=2)})\n"
+            if args.spec_draft else ""
+        )
+        + f"serve telemetry: {sink.path}"
     )
     return snap
 

@@ -220,6 +220,19 @@ def parse_args(argv=None):
     parser.add_argument("--serve_slots", default=4, type=int,
                         help="with --serve: KV slot-pool size (the decode "
                         "batch)")
+    parser.add_argument("--spec_draft", default=0, type=int,
+                        help="with --serve: speculative decoding via an "
+                        "early-exit draft of this DEPTH (the target's "
+                        "first N blocks sharing its weights, "
+                        "tpudist.serve.spec.early_exit_draft; 0 = off). "
+                        "Each tick the draft proposes --spec_k tokens per "
+                        "slot and the target verifies the window in one "
+                        "bulk pass; greedy output is token-identical to "
+                        "the non-speculative engine (docs/SERVING.md §6)")
+    parser.add_argument("--spec_k", default=4, type=int,
+                        help="with --spec_draft: draft tokens proposed per "
+                        "slot per tick (a slot emits up to spec_k+1 "
+                        "tokens per verified sweep)")
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
     parser.add_argument("--checkpoint_dir", default=None, type=str,
@@ -289,8 +302,18 @@ def _serve_demo(args):
             print(f"request {ev.request_id}: {streamed[ev.request_id]} "
                   "tokens (done)")
 
+    spec_kw = {}
+    if args.spec_draft:
+        from tpudist.serve import early_exit_draft
+
+        draft_model, draft_params = early_exit_draft(
+            model, params, args.spec_draft
+        )
+        spec_kw = dict(draft_model=draft_model, draft_params=draft_params,
+                       spec_k=args.spec_k)
     engine = ServeEngine(model, params, max_slots=args.serve_slots,
-                         sink=sink, stats_every=10, on_token=on_token)
+                         sink=sink, stats_every=10, on_token=on_token,
+                         **spec_kw)
     rng = np.random.Generator(np.random.PCG64(0))
     for i in range(args.serve_requests):
         engine.submit(
@@ -313,6 +336,12 @@ def _serve_demo(args):
         f"TPOT p50 {fmt_s(snap['tpot_p50'], 1e3, 1)}ms, slot utilization "
         f"{fmt_s(snap['slot_utilization'], digits=2)}"
     )
+    if args.spec_draft:
+        print(
+            f"speculative: {snap['spec_accepted']}/{snap['spec_drafted']} "
+            "drafts accepted (rate "
+            f"{fmt_s(snap['spec_acceptance_rate'], digits=2)})"
+        )
     print(f"serve telemetry: {sink.path}")
     return snap
 

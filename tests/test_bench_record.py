@@ -139,6 +139,9 @@ def test_compact_summary_bounded_with_full_real_leg_inventory(
     assert len(names) >= 14  # the inventory harvest didn't silently thin out
     assert "gpt2_124m_telemetry_overhead_pct" in names
     assert "telemetry" in bench._LEG_GROUPS  # the leg is scheduled, too
+    # the speculative-decoding A/B leg (docs/SERVING.md §6, PERF §7d)
+    assert "gpt2_124m_spec_serve_tokens_per_sec" in names
+    assert "spec" in bench._LEG_GROUPS
     for n in sorted(names):
         bench._emit(n, 123456.789, "unit prose the compact line drops " * 4,
                     100000.0)

@@ -119,6 +119,29 @@ def test_composed_cell_with_zero1_telemetry_and_guard():
     np.testing.assert_allclose(got, want, rtol=2e-4)
 
 
+def test_zero1_overlay_parity_and_placement():
+    """The ('fsdp','data') mirror overlay is placement, not math: a
+    data×fsdp cell with plan.wrap_zero1 trains the SAME trajectory as
+    the pure-DP reference, and the born state's skipped-leaf mirrors
+    really carry the joint spec (sharded data-ways on top of fsdp while
+    their params keep plain fsdp)."""
+    want = _trajectory(None)
+    plan = _plan(data=2, fsdp=2)
+    got = _trajectory(plan, shard_opt_state=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+    model = GPT2(**_GPT2_CFG)
+    tx = plan.wrap_zero1(optax.adam(1e-3))
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 16), jnp.int32), tx, plan=plan
+    )
+    # wte [64, 32]: fsdp scatters dim 0 (64 % 2 == 0), overlay upgrades
+    # it (64 % 4 == 0) — mirror at 1/4 per chip, param at 1/2
+    mu = state.opt_state[0].mu["wte"]
+    assert mu.sharding.spec == P((FSDP_AXIS, DATA_AXIS), None)
+    assert state.params["wte"].sharding.spec == P(FSDP_AXIS, None)
+    assert mu.addressable_shards[0].data.size * 4 == mu.size
+
+
 def test_plan_state_is_actually_sharded():
     """The plan's placements are real: TP metadata kept on the qkv kernel,
     an unannotated leaf (wpe) scattered over fsdp, and the Adam mirrors
@@ -159,9 +182,18 @@ def test_wrap_zero1_skips_fsdp_leaves():
     assert sh.mu["padme"].spec == P(DATA_AXIS, None)
     assert not spec_is_sharded(sh.mu["fsdpable"].spec, plan.mesh)
     # ...and the plan's overlay gives the skipped leaf its fsdp placement
+    # UPGRADED over ('fsdp','data') jointly: the mirror shards data-ways
+    # too (ZeRO-1's point) while the param keeps plain fsdp — the dim
+    # divides fsdp*data here (2048 % 4 == 0)
     composed = plan.opt_state_shardings(params, tx)
-    assert FSDP_AXIS in tuple(composed.mu["fsdpable"].spec)
+    assert composed.mu["fsdpable"].spec == P((FSDP_AXIS, DATA_AXIS), None)
     assert composed.mu["padme"].spec == P(DATA_AXIS, None)
+    # a dim divisible by fsdp but NOT fsdp*data keeps the plain fsdp
+    # scatter (no overlay)
+    odd = {"odd": jnp.zeros((1026, 3))}  # 1026 = 2*513, not /4
+    odd_tx = plan.wrap_zero1(optax.scale_by_adam())
+    odd_composed = plan.opt_state_shardings(odd, odd_tx)
+    assert odd_composed.mu["odd"].spec == P(FSDP_AXIS, None)
     # mirrors of METADATA-sharded params stay aligned with their params
     # (tensor spec kept through the overlay — the update must never have
     # to reshard the moments against their grads)

@@ -1,0 +1,439 @@
+"""Speculative decoding (tpudist.serve.spec + the engine's spec mode).
+
+The load-bearing contract: speculation changes THROUGHPUT, never the
+output distribution. Greedy speculative engine output must be
+token-identical to the non-speculative engine (and hence to static
+``generate()``) under staggered arrivals and slot pressure — on both
+model families, contiguous and paged, through eviction/preemption
+cycles. Sampled mode is pinned statistically at the acceptance-rule
+level (the emitted-token marginal equals the warped target
+distribution). Plus: the per-row warped log-prob helper the ratio test
+shares with the sampler, the device-carried cursor ("rollback" is
+bookkeeping) invariant, multi-token TokenEvent ordering, spec telemetry
+counters, and the paged ``ensure_to`` / equal-HBM helpers.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.generate import (
+    generate, per_row_log_probs, sample_logits_per_row,
+)
+from tpudist.models.gpt2 import GPT2
+from tpudist.models.llama import Llama
+from tpudist.serve import ServeEngine, SlotPool
+from tpudist.serve.blocks import PagedSlotPool, draft_equivalent_blocks
+from tpudist.serve.spec import (
+    cache_bytes, early_exit_draft, speculative_accept,
+)
+
+
+def _gpt2(max_seq_len=64):
+    return GPT2(vocab_size=64, max_seq_len=max_seq_len, hidden_dim=32,
+                depth=2, num_heads=4)
+
+
+def _llama(max_seq_len=64):
+    return Llama(vocab_size=64, max_seq_len=max_seq_len, hidden_dim=32,
+                 depth=2, num_heads=4, num_kv_heads=2, ffn_dim=64)
+
+
+def _params(model, seed=0):
+    return model.init(
+        jax.random.key(seed), np.zeros((1, 8), np.int32), train=False
+    )["params"]
+
+
+def _prompts(lens, vocab=64, seed=0):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    return [rng.integers(0, vocab, (p,)).astype(np.int32) for p in lens]
+
+
+# ---------------------------------------------------------------------------
+# per_row_log_probs: the warped distribution the ratio test divides by
+
+
+def test_per_row_log_probs_matches_sampler_filter():
+    """The log-probs must describe EXACTLY the distribution
+    sample_logits_per_row draws from: temperature scaling, then the
+    top-k/top-p keep-set, renormalized — and a greedy row (temp 0) is a
+    point mass at the argmax (what makes greedy speculation exact)."""
+    rng = np.random.Generator(np.random.PCG64(0))
+    logits = jnp.asarray(rng.normal(0, 2, (3, 16)).astype(np.float32))
+    temperature = jnp.asarray([0.0, 0.7, 1.3], jnp.float32)
+    top_k = jnp.asarray([0, 4, 0], jnp.int32)
+    top_p = jnp.asarray([1.0, 1.0, 0.8], jnp.float32)
+    lp = np.asarray(per_row_log_probs(
+        logits, temperature=temperature, top_k=top_k, top_p=top_p
+    ))
+    # row 0 greedy: point mass
+    g = int(np.argmax(np.asarray(logits[0])))
+    assert lp[0, g] == 0.0
+    assert np.all(np.isneginf(np.delete(lp[0], g)))
+    # row 1 top-k=4: mass only on the 4 largest, softmax over them
+    scaled = np.asarray(logits[1]) / 0.7
+    keep = np.argsort(scaled)[-4:]
+    assert set(np.nonzero(np.isfinite(lp[1]))[0]) == set(keep)
+    ref = np.exp(scaled[keep]) / np.exp(scaled[keep]).sum()
+    np.testing.assert_allclose(
+        np.exp(lp[1, keep]), ref, rtol=1e-5, atol=1e-6
+    )
+    # every row is a normalized distribution
+    np.testing.assert_allclose(
+        np.exp(lp).sum(axis=-1), 1.0, rtol=1e-5
+    )
+    # row 2 nucleus: the kept set is the smallest prefix covering top_p
+    probs = np.exp(scaled2 := np.asarray(logits[2]) / 1.3)
+    probs /= probs.sum()
+    order = np.argsort(probs)[::-1]
+    csum = np.cumsum(probs[order])
+    n_keep = int(np.searchsorted(csum, 0.8) + 1)
+    assert set(np.nonzero(np.isfinite(lp[2]))[0]) == set(order[:n_keep])
+
+
+# ---------------------------------------------------------------------------
+# speculative_accept: exactness (greedy) and distribution preservation
+
+
+def _draft_for(d_logits, keys, temperature, top_k, top_p):
+    """Draft tokens exactly the way the engine drafts them: step i
+    samples from the warped draft row with salt i."""
+    b, k, _ = d_logits.shape
+    toks = []
+    for i in range(k):
+        ki = jax.vmap(lambda kk: jax.random.fold_in(kk, i))(keys)
+        toks.append(sample_logits_per_row(
+            d_logits[:, i], ki, temperature=temperature, top_k=top_k,
+            top_p=top_p,
+        ))
+    return jnp.stack(toks, axis=1)
+
+
+def test_speculative_accept_greedy_is_target_argmax_prefix():
+    """Greedy rows: whatever the draft proposed, the emitted window is
+    exactly the target's argmax chain prefix — accepted drafts matched
+    the argmax, the correction/bonus IS the argmax."""
+    rng = np.random.Generator(np.random.PCG64(1))
+    b, k, v = 24, 3, 32
+    t_logits = jnp.asarray(rng.normal(0, 1.5, (b, k + 1, v)).astype(np.float32))
+    d_logits = jnp.asarray(rng.normal(0, 1.5, (b, k, v)).astype(np.float32))
+    # half the rows: draft agrees with the target argmax on every step
+    agree = np.asarray(t_logits[: b // 2, :k])
+    d_logits = d_logits.at[: b // 2].set(jnp.asarray(agree))
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(
+        jax.random.key(9), jnp.arange(b)
+    )
+    zeros = jnp.zeros(b, jnp.float32)
+    d_toks = _draft_for(d_logits, keys, zeros, jnp.zeros(b, jnp.int32),
+                        jnp.ones(b, jnp.float32))
+    emit, n_emit = speculative_accept(
+        t_logits, d_logits, d_toks, jnp.full(b, k, jnp.int32), keys,
+        temperature=zeros, top_k=jnp.zeros(b, jnp.int32),
+        top_p=jnp.ones(b, jnp.float32),
+    )
+    emit, n_emit = np.asarray(emit), np.asarray(n_emit)
+    argmax = np.argmax(np.asarray(t_logits), axis=-1)
+    for r in range(b):
+        for j in range(n_emit[r]):
+            assert emit[r, j] == argmax[r, j], (r, j)
+    # agreeing drafts accept everything: K drafts + the bonus token
+    assert np.all(n_emit[: b // 2] == k + 1)
+
+
+def test_speculative_accept_preserves_target_distribution():
+    """The acceptance identity, empirically: over many independent rows
+    with the SAME logits, the first emitted token's marginal equals the
+    warped target distribution (TVD well under the sampling noise floor)
+    — speculation is throughput, not distribution shift."""
+    rng = np.random.Generator(np.random.PCG64(7))
+    b, k, v = 4000, 2, 12
+    t_row = rng.normal(0, 1.2, (k + 1, v)).astype(np.float32)
+    d_row = rng.normal(0, 1.2, (k, v)).astype(np.float32)
+    t_logits = jnp.broadcast_to(jnp.asarray(t_row), (b, k + 1, v))
+    d_logits = jnp.broadcast_to(jnp.asarray(d_row), (b, k, v))
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(
+        jax.random.key(3), jnp.arange(b)
+    )
+    temperature = jnp.full(b, 0.9, jnp.float32)
+    top_k = jnp.full(b, 6, jnp.int32)
+    top_p = jnp.full(b, 0.92, jnp.float32)
+    d_toks = _draft_for(d_logits, keys, temperature, top_k, top_p)
+    emit, n_emit = speculative_accept(
+        t_logits, d_logits, d_toks, jnp.full(b, k, jnp.int32), keys,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )
+    p0 = np.exp(np.asarray(per_row_log_probs(
+        jnp.asarray(t_row[:1]), temperature=temperature[:1],
+        top_k=top_k[:1], top_p=top_p[:1],
+    ))[0])
+    emp = np.bincount(np.asarray(emit)[:, 0], minlength=v) / b
+    tvd = 0.5 * np.abs(emp - p0).sum()
+    assert tvd < 0.03, tvd  # measured ~0.009; noise floor ~sqrt(v/b)~0.05
+    # and speculation actually accepts: the draft shares no structure
+    # with the target here, yet SOME proposals land in the overlap
+    assert int(np.asarray(n_emit).max()) > 1
+
+    # n_spec=0 rows degrade to the plain warped target draw
+    emit0, n_emit0 = speculative_accept(
+        t_logits, d_logits, d_toks, jnp.zeros(b, jnp.int32), keys,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )
+    assert np.all(np.asarray(n_emit0) == 1)
+    emp0 = np.bincount(np.asarray(emit0)[:, 0], minlength=v) / b
+    assert 0.5 * np.abs(emp0 - p0).sum() < 0.03
+
+
+# ---------------------------------------------------------------------------
+# engine: greedy bit-identity under stagger + slot pressure
+
+
+def test_spec_engine_greedy_matches_static_gpt2(tmp_path):
+    """GPT-2, staggered arrivals, 2 slots for 4 requests: every
+    speculative-engine stream equals the static generate() row — the
+    acceptance criterion's bit-identity pin. The engine writes to a
+    telemetry sink so the same run pins the spec schema fields on the
+    `serve` rows and the `serve_summary` (docs/OBSERVABILITY.md §1)."""
+    from tpudist.telemetry import TelemetrySink
+
+    model = _gpt2()
+    prompts = np.stack(_prompts([6, 6, 6, 6], seed=1))
+    params = _params(model, 1)
+    draft, dparams = early_exit_draft(model, params, 1)
+    static = generate(model, params, prompts, 10, temperature=0.0)
+
+    sink = TelemetrySink(str(tmp_path / "s.jsonl"))
+    eng = ServeEngine(model, params, max_slots=2, seed=0, sink=sink,
+                      stats_every=1, draft_model=draft,
+                      draft_params=dparams, spec_k=3)
+    rids = [eng.submit(prompts[i], 10) for i in range(2)]
+    for _ in range(3):  # the stagger: later requests arrive mid-decode
+        eng.step()
+    rids += [eng.submit(prompts[i], 10) for i in (2, 3)]
+    out = eng.run()
+    for i in range(4):
+        np.testing.assert_array_equal(out[rids[i]], static[i])
+    snap = eng.stats.snapshot()
+    assert snap["spec_drafted"] > 0
+    sink.close()
+    rows = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    serve = [r for r in rows if r["kind"] == "serve"]
+    assert serve and all(
+        {"spec_drafted", "spec_accepted", "spec_acceptance_rate"}
+        <= set(r) for r in serve
+    )
+    assert sum(r["spec_drafted"] for r in serve) > 0
+    summary = [r for r in rows if r["kind"] == "serve_summary"][-1]
+    assert summary["spec_drafted"] >= summary["spec_accepted"] > 0
+    assert 0 < summary["spec_acceptance_rate"] <= 1.0
+
+
+def test_spec_engine_greedy_matches_plain_engine_llama_with_eos():
+    """Llama (per-row RoPE path), mixed lengths, per-request stop token:
+    the speculative engine's streams equal the non-speculative engine's
+    token for token — including eos cuts discovered mid-window."""
+    model = _llama()
+    params = _params(model, 2)
+    prompts = _prompts([3, 6, 5, 9], seed=3)
+
+    def run(spec_kw):
+        eng = ServeEngine(model, params, max_slots=2, seed=0, **spec_kw)
+        rids = [eng.submit(pr, 12, eos_id=7) for pr in prompts[:3]]
+        for _ in range(2):
+            eng.step()
+        rids.append(eng.submit(prompts[3], 12, eos_id=7))
+        return [eng.run()[r] for r in rids]
+
+    draft, dparams = early_exit_draft(model, params, 1)
+    plain = run({})
+    spec = run(dict(draft_model=draft, draft_params=dparams, spec_k=4))
+    for a, b in zip(plain, spec):
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# rollback = cursor bookkeeping; multi-token events; telemetry
+
+
+def test_spec_sampled_budget_eos_and_cursor_invariant():
+    """Sampled speculative serving, stepped by hand: every stream stops
+    within budget and never past its stop token — and after EVERY tick,
+    each owned slot's synced cursor equals prompt_len + emitted − 1 (the
+    position its NEXT input token writes at). That cursor equality IS
+    the draft-"rollback" contract: rejected drafts moved nothing but the
+    cursor, whatever the acceptance pattern was."""
+    model = _gpt2()
+    params = _params(model, 1)
+    draft, dparams = early_exit_draft(model, params, 1)
+    eng = ServeEngine(model, params, max_slots=3, seed=5,
+                      draft_model=draft, draft_params=dparams, spec_k=3)
+    prompts = _prompts([4, 7, 5, 9, 6], seed=11)
+    rids = [
+        eng.submit(pr, 9, temperature=0.9, top_k=20, top_p=0.95, eos_id=5)
+        for pr in prompts
+    ]
+    plens = {r: len(p) for r, p in zip(rids, prompts)}
+    while eng.pending:
+        eng.step()
+        for slot in np.nonzero(eng.pool.active)[0]:
+            rid = int(eng._req[slot])
+            if rid < 0 or rid not in eng._counts:
+                continue
+            assert eng.pool.positions[slot] == (
+                plens[rid] + eng._counts[rid] - 1
+            ), (slot, rid)
+    for r in rids:
+        toks = eng.result(r)
+        assert 1 <= len(toks) <= 9
+        assert all(t != 5 for t in toks[:-1])
+    assert not eng.pending
+
+
+def test_spec_multi_token_events_ordered_with_full_acceptance():
+    """With the draft == the target every proposal is accepted: each live
+    slot emits spec_k+1 tokens per tick (the full-accept bonus path), so
+    a single tick's event list carries runs of consecutive indices per
+    request — in order, each its own TokenEvent, done only on the last,
+    on_token seeing exactly the same sequence events() yields."""
+    model = _gpt2()
+    params = _params(model, 1)
+    seen: list[tuple[int, int, bool]] = []
+    eng = ServeEngine(
+        model, params, max_slots=2, seed=0, draft_model=model,
+        draft_params=params, spec_k=3,
+        on_token=lambda ev: seen.append((ev.request_id, ev.index, ev.done)),
+    )
+    prompts = _prompts([4, 6], seed=4)
+    rids = [eng.submit(pr, 9) for pr in prompts]
+    streamed = list(eng.events())
+    assert [(e.request_id, e.index, e.done) for e in streamed] == seen
+    for r in rids:
+        idx = [e.index for e in streamed if e.request_id == r]
+        assert idx == list(range(9))
+        dones = [e.done for e in streamed if e.request_id == r]
+        assert dones == [False] * 8 + [True]
+    # full acceptance on-record, and some tick really batched K+1 events
+    # for one request (multi-token emission, not one-at-a-time)
+    snap = eng.stats.snapshot()
+    assert snap["spec_acceptance_rate"] == 1.0
+    assert snap["spec_accepted"] == snap["spec_drafted"] > 0
+
+
+# ---------------------------------------------------------------------------
+# paged + spec: eviction / preemption torture
+
+
+def test_spec_paged_preemption_torture_keeps_greedy_identity():
+    """Paged speculative serving under real block starvation: a pool far
+    too small for the worst case forces the whole escalation ladder
+    (force-fetch, prefix eviction, preempt-to-queue with replay), and
+    every stream STILL equals the plain contiguous engine's greedy
+    output — speculation composes with paged memory without touching
+    the replay/rng/cursor contract."""
+    model = _gpt2()
+    params = _params(model, 3)
+    prompts = _prompts([9, 11, 8, 12, 10, 7], seed=5)
+    draft, dparams = early_exit_draft(model, params, 1)
+
+    plain = ServeEngine(model, params, max_slots=3, seed=0)
+    rids = [plain.submit(pr, 20) for pr in prompts]
+    want = [plain.run()[r] for r in rids]
+
+    eng = ServeEngine(
+        model, params, max_slots=3, seed=0, paged=True, block_size=4,
+        n_blocks=13, watermark_blocks=1, draft_model=draft,
+        draft_params=dparams, spec_k=3,
+    )
+    rids = [eng.submit(pr, 20) for pr in prompts]
+    got = [eng.run()[r] for r in rids]
+    assert got == [list(w) for w in want]
+    assert eng.stats.preemptions > 0  # the torture actually tortured
+
+
+def test_spec_paged_ensure_to_maps_whole_window():
+    """ensure_to maps every block the conservative dispatch window needs
+    in one call, reports dry pools, and never exceeds the table."""
+    model = _gpt2()
+    pool = PagedSlotPool(model, 2, n_blocks=6, block_size=8)
+    row = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: model.init_cache(1)),
+    )
+    slot = pool.insert(row, 5, prompt=np.arange(5, dtype=np.int32))
+    assert pool.ensure_to(slot, 20)  # 3 blocks for 20 tokens
+    assert int(pool.fill[slot]) == 3
+    assert pool.ensure_to(slot, 20)  # idempotent
+    assert int(pool.fill[slot]) == 3
+    assert not pool.ensure_to(slot, 64)  # 8 blocks > 5 usable: dry
+    assert int(pool.fill[slot]) == 5  # partial progress stays mapped
+
+
+def test_draft_equivalent_blocks_buys_the_draft_bytes():
+    """The equal-HBM handicap: the extra target blocks the AR baseline
+    gets must cover the draft pool's bytes (rounded up)."""
+    model = _gpt2()
+    draft = model.clone(depth=1)
+    extra = draft_equivalent_blocks(model, draft, max_slots=4, block_size=8)
+    per_block = cache_bytes(model, 1) // model.max_seq_len * 8
+    assert extra * per_block >= cache_bytes(draft, 4)
+    assert (extra - 1) * per_block < cache_bytes(draft, 4)
+
+
+# ---------------------------------------------------------------------------
+# construction validation + helpers
+
+
+def test_spec_engine_validates_draft():
+    model = _gpt2()
+    params = _params(model)
+    draft, dparams = early_exit_draft(model, params, 1)
+    with pytest.raises(ValueError, match="draft_params"):
+        ServeEngine(model, params, draft_model=draft)
+    with pytest.raises(ValueError, match="spec_k"):
+        ServeEngine(model, params, draft_model=draft, draft_params=dparams,
+                    spec_k=0)
+    with pytest.raises(ValueError, match="vocab"):
+        ServeEngine(model, params, draft_model=_gpt2().clone(vocab_size=32),
+                    draft_params=dparams)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        ServeEngine(model, params, draft_model=model.clone(max_seq_len=32),
+                    draft_params=dparams)
+
+
+def test_early_exit_draft_slices_and_validates():
+    model = _gpt2()
+    params = _params(model)
+    draft, dparams = early_exit_draft(model, params, 1)
+    assert draft.depth == 1 and draft.vocab_size == model.vocab_size
+    assert set(dparams) == {"wte", "wpe", "ln_f", "h_0"}
+    # shared arrays, not copies: zero extra weight HBM
+    assert all(
+        a is b for a, b in zip(
+            jax.tree_util.tree_leaves(dparams["wte"]),
+            jax.tree_util.tree_leaves(params["wte"]),
+        )
+    )
+    with pytest.raises(ValueError, match="depth"):
+        early_exit_draft(model, params, model.depth)
+    with pytest.raises(ValueError, match="unrolled"):
+        early_exit_draft(model, {"wte": {}, "wpe": {}, "ln_f": {}}, 1)
+    llama = _llama()
+    lp = _params(llama)
+    ld, ldp = early_exit_draft(llama, lp, 1)
+    assert set(ldp) == {"embed", "norm", "lm_head", "layer_0"}
+
+
+def test_write_row_pins_slot_and_validates_range():
+    model = _gpt2()
+    pool = SlotPool(model, 2)
+    row = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: model.init_cache(1)),
+    )
+    pool.write_row(row, 1)
+    assert pool.n_active == 0  # bypasses occupancy bookkeeping
+    with pytest.raises(ValueError, match="slot"):
+        pool.write_row(row, 2)

@@ -134,31 +134,14 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
 PER_ROW_TOPK_CAP = 128
 
 
-def sample_logits_per_row(logits, keys, *, temperature, top_k, top_p):
-    """Per-ROW sampling over ``[B, V]`` logits: ``temperature``/``top_k``/
-    ``top_p`` are ``[B]`` arrays and ``keys`` is a ``[B]`` array of rng
-    keys — one compiled program serves every mix of per-slot sampling
-    params, which is what lets the serving engine keep requests with
-    different decoding configs in ONE masked decode step
-    (:mod:`tpudist.serve.engine`).
-
-    Per-row semantics: ``temperature == 0`` is greedy (the same
-    first-occurrence ``lax.top_k(·, 1)`` winner as :func:`sample_logits`,
-    so a greedy slot is bit-identical to the static path); ``top_k <= 0``
-    disables the top-k filter for that row; ``top_p >= 1`` disables
-    nucleus. Filters compose in the HF order (temperature → top_k →
-    top_p) and resolve inside a static top-``PER_ROW_TOPK_CAP`` candidate
-    subset: per-row ``top_k`` clamps to the cap, and a ``top_p`` whose
-    nucleus would extend past the cap keeps exactly the cap's candidates
-    (vocab-size subsets are exact — the cap only binds at ``V > 128``).
-    Tie semantics are THRESHOLD-based (every id tied with the k-th value
-    is kept, like HF's warper; the scalar path keeps exactly k) — for
-    float logits ties have measure zero. Sampling is gumbel-max with one
-    ``[V]`` gumbel field per row from that row's key (each slot owns an
-    rng stream independent of its neighbors — retiring or admitting a
-    request cannot perturb another slot's draw); an unfiltered row's
-    categorical runs over the full vocab, a filtered row's over its
-    candidate subset through the same gumbel field."""
+def _per_row_warp(logits, temperature, top_k, top_p):
+    """The per-row filter resolution shared by :func:`sample_logits_per_row`
+    and :func:`per_row_log_probs`: temperature scaling, the static
+    top-``PER_ROW_TOPK_CAP`` candidate subset, and the composed top-k /
+    nucleus cut expressed as ONE per-row value threshold. Factored out so
+    the speculative-decoding acceptance ratio (:mod:`tpudist.serve.spec`)
+    scores EXACTLY the distribution the sampler draws from — any drift
+    between the two breaks the acceptance-rejection identity."""
     b, v = logits.shape
     temperature = jnp.asarray(temperature, jnp.float32)
     cap = min(PER_ROW_TOPK_CAP, v)
@@ -192,6 +175,67 @@ def sample_logits_per_row(logits, keys, *, temperature, top_k, top_p):
     )
     p_thresh = jnp.where(p_active[:, None], p_thresh, -jnp.inf)
     thresh = jnp.maximum(k_thresh, p_thresh)  # [B, 1]
+    return (greedy, scaled, top_vals, top_idx, masked_vals, thresh,
+            k_active, p_active, temperature)
+
+
+def per_row_log_probs(logits, *, temperature, top_k, top_p):
+    """Log-probabilities ``[B, V]`` of the WARPED per-row distribution
+    :func:`sample_logits_per_row` draws from — the exact ``log p(token)``
+    the speculative-decoding acceptance ratio needs for both the target
+    and the draft side (:mod:`tpudist.serve.spec`). Filtered-out tokens
+    are ``-inf``; kept tokens are renormalized over the kept set.
+
+    Greedy rows (``temperature == 0``) are a point mass: ``0.0`` at the
+    first-occurrence argmax, ``-inf`` elsewhere — the distribution the
+    greedy branch of the sampler actually realizes.
+
+    The kept set is expressed as the full-vocab threshold test
+    ``scaled >= thresh`` rather than a candidate-subset membership list;
+    the two coincide except on exact value ties at the cut boundary
+    (measure zero for float logits — the same tie caveat the sampler
+    documents)."""
+    (greedy, scaled, _, _, _, thresh, k_active, p_active,
+     temperature) = _per_row_warp(logits, temperature, top_k, top_p)
+    filtered = (k_active | p_active)[:, None]
+    keep = jnp.where(filtered, scaled >= thresh, True)
+    masked = jnp.where(keep, scaled, -jnp.inf)
+    logp = masked - jax.nn.logsumexp(masked, axis=-1, keepdims=True)
+    v = logits.shape[-1]
+    point = jnp.where(
+        jnp.arange(v)[None, :] == greedy[:, None], 0.0, -jnp.inf
+    )
+    return jnp.where((temperature == 0.0)[:, None], point, logp)
+
+
+def sample_logits_per_row(logits, keys, *, temperature, top_k, top_p):
+    """Per-ROW sampling over ``[B, V]`` logits: ``temperature``/``top_k``/
+    ``top_p`` are ``[B]`` arrays and ``keys`` is a ``[B]`` array of rng
+    keys — one compiled program serves every mix of per-slot sampling
+    params, which is what lets the serving engine keep requests with
+    different decoding configs in ONE masked decode step
+    (:mod:`tpudist.serve.engine`).
+
+    Per-row semantics: ``temperature == 0`` is greedy (the same
+    first-occurrence ``lax.top_k(·, 1)`` winner as :func:`sample_logits`,
+    so a greedy slot is bit-identical to the static path); ``top_k <= 0``
+    disables the top-k filter for that row; ``top_p >= 1`` disables
+    nucleus. Filters compose in the HF order (temperature → top_k →
+    top_p) and resolve inside a static top-``PER_ROW_TOPK_CAP`` candidate
+    subset: per-row ``top_k`` clamps to the cap, and a ``top_p`` whose
+    nucleus would extend past the cap keeps exactly the cap's candidates
+    (vocab-size subsets are exact — the cap only binds at ``V > 128``).
+    Tie semantics are THRESHOLD-based (every id tied with the k-th value
+    is kept, like HF's warper; the scalar path keeps exactly k) — for
+    float logits ties have measure zero. Sampling is gumbel-max with one
+    ``[V]`` gumbel field per row from that row's key (each slot owns an
+    rng stream independent of its neighbors — retiring or admitting a
+    request cannot perturb another slot's draw); an unfiltered row's
+    categorical runs over the full vocab, a filtered row's over its
+    candidate subset through the same gumbel field."""
+    b, v = logits.shape
+    (greedy, scaled, top_vals, top_idx, masked_vals, thresh, k_active,
+     p_active, temperature) = _per_row_warp(logits, temperature, top_k, top_p)
     # ONE [B, V] gumbel field serves both sampling flavors: unfiltered
     # rows argmax over the full vocab; filtered rows over their candidate
     # subset (the subset reads its gumbel values through top_idx, so a

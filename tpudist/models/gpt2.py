@@ -282,23 +282,31 @@ class GPT2(nn.Module):
             # neither read nor advanced (the engine owns per-slot lengths),
             # but stays declared so the cache tree matches the scalar path
             self.variable("cache", "position", lambda: jnp.zeros((), jnp.int32))
-            if s != 1:
-                raise ValueError("per-row-position decode is single-token")
             positions = jnp.asarray(positions, jnp.int32)
-            overrun = positions + s > self.max_seq_len
+            # per-ENTRY overrun: row b's chunk entry i sits at pos_b + i.
+            # s > 1 is the speculative verify chunk (tpudist.serve.spec),
+            # whose tail may legitimately poke past the table on a
+            # near-end row — those entries NaN-poison individually (their
+            # K/V writes self-clamp in cached_kv and the engine's
+            # acceptance cap never consumes their logits), while an
+            # eagerly-detected FULLY-overrun row still fails loudly.
+            row_pos = positions[:, None] + jnp.arange(s)[None, :]  # [B, s]
+            overrun = row_pos + 1 > self.max_seq_len
             # probe OVERRUN for tracer-ness, not positions: under jit a
             # closed-over concrete positions array still yields a traced
             # comparison (constants lift to tracers inside the trace)
             if not isinstance(overrun, jax.core.Tracer) and bool(
-                jnp.any(overrun)
+                jnp.any(overrun[:, 0])
             ):
                 raise ValueError(
                     f"per-slot decode past max_seq_len {self.max_seq_len} "
                     f"(positions {positions}); the KV cache and wpe table "
                     "end there"
                 )
-            pos = jnp.take(wpe, positions, axis=0)[:, None, :]  # [B, 1, d]
-            pos = jnp.where(overrun[:, None, None], jnp.nan, pos)
+            pos = jnp.take(
+                wpe, jnp.minimum(row_pos, self.max_seq_len - 1), axis=0
+            )  # [B, s, d]
+            pos = jnp.where(overrun[:, :, None], jnp.nan, pos)
         elif decode:
             # learned positions follow the cache cursor, not [0, s); the
             # init trace only creates the counter (no advance)

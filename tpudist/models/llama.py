@@ -124,11 +124,14 @@ class LlamaBlock(nn.Module):
 
             def rope_positions(pos):
                 # scalar cursor: the chunk rows sit at pos..pos+s-1; per-row
-                # cursors ([B], slot-pooled decode): each row at its own
-                # single position
+                # cursors ([B], slot-pooled decode): row b's chunk rows at
+                # pos_b..pos_b+s-1 (s > 1 is the speculative verify chunk;
+                # RoPE has no table to overrun, so no tail clamp is needed)
                 if jnp.ndim(pos) == 0:
                     return (pos + jnp.arange(s)).astype(jnp.float32)
-                return pos[:, None].astype(jnp.float32)  # [B, 1]
+                return (
+                    pos[:, None] + jnp.arange(s)[None, :]
+                ).astype(jnp.float32)  # [B, s]
 
             def rotate_k(k, v, pos):
                 return apply_rope(k, theta=self.rope_theta,

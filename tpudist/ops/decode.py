@@ -68,9 +68,14 @@ def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None,
 
     ``positions`` switches to slot-pooled decode (``tpudist.serve``): a
     ``[B]`` int32 vector of PER-ROW absolute positions. Each row's K/V is
-    scattered at its own cursor and the mask is per-row (``slot <= pos_b``)
-    — the shape discipline that lets requests at different sequence
-    lengths share one compiled decode step. Single-token steps only; the
+    scattered at its own cursor and the mask is per-row AND causal within
+    the chunk (``slot <= pos_b + i``) — the shape discipline that lets
+    requests at different sequence lengths share one compiled decode
+    step. ``s > 1`` is the speculative-decoding VERIFY sweep
+    (``tpudist.serve.spec``): row ``b``'s chunk entries land at
+    ``pos_b .. pos_b + s - 1``, and entries past ``max_len`` self-clamp
+    (their one-hot is empty — nothing is written, and the engine's
+    acceptance cap guarantees such tail entries are never consumed). The
     module's scalar ``cache_index`` is neither read nor advanced (the
     engine owns per-slot lengths), but it stays declared so the cache
     tree's structure is identical in both modes — a jit'd loop can donate
@@ -119,16 +124,11 @@ def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None,
     if block_tables is not None:
         if positions is None:
             raise ValueError("paged decode needs per-row positions")
-        if s != 1:
-            raise ValueError(
-                f"paged decode is single-token (got chunk {s}); prefill "
-                "runs on a contiguous batch-1 cache and is scattered into "
-                "blocks afterwards (tpudist.serve.blocks)"
-            )
         pool_k, pool_v = ck.value, cv.value  # [N, H_kv, bs, dh]
         bs_blk = pool_k.shape[2]
         pos = jnp.asarray(positions, jnp.int32)
         bt = jnp.asarray(block_tables, jnp.int32)
+        mb = bt.shape[1] if bt.ndim == 2 else 0
         if pos.shape != (b,):
             raise ValueError(f"positions must be [{b}], got {pos.shape}")
         if bt.ndim != 2 or bt.shape[0] != b:
@@ -137,54 +137,64 @@ def cached_kv(module, k, v, max_len: int, pre_update=None, positions=None,
             )
         if pre_update is not None:
             k, v = pre_update(k, v, pos)
-        # physical write coordinates: each row's single token lands in the
-        # block its cursor maps to, at the in-block offset
-        blk = jnp.take_along_axis(bt, (pos // bs_blk)[:, None], axis=1)[:, 0]
-        off = pos % bs_blk
-        kt = k[:, 0].astype(pool_k.dtype)  # [B, H_kv, dh]
-        vt = v[:, 0].astype(pool_v.dtype)
+        kt = k.astype(pool_k.dtype).transpose(0, 2, 1, 3)  # [B, H_kv, s, dh]
+        vt = v.astype(pool_v.dtype).transpose(0, 2, 1, 3)
 
-        # B sequential single-(block,offset) dynamic_update_slices carried
-        # through a fori_loop: each updates a [1, H, 1, dh] sliver of the
-        # donated pool in place. A gather-scatter (`.at[blk, :, off, :]`)
-        # would block XLA's in-place path and copy the WHOLE pool per
-        # layer per step — the exact copy the paged layout exists to avoid
-        # (the same measurement that shaped the contiguous one-hot write).
-        def write(i, pools):
+        # B×s sequential single-(block,offset) dynamic_update_slices
+        # carried through a fori_loop: each updates a [1, H, 1, dh] sliver
+        # of the donated pool in place. A gather-scatter
+        # (`.at[blk, :, off, :]`) would block XLA's in-place path and copy
+        # the WHOLE pool per layer per step — the exact copy the paged
+        # layout exists to avoid (the same measurement that shaped the
+        # contiguous one-hot write). Chunk entries past the table's
+        # logical extent (the speculative verify tail of a near-end row)
+        # redirect to block 0 — the reserved garbage block
+        # (tpudist.serve.blocks.GARBAGE_BLOCK); unmapped mid-table entries
+        # are already 0 in the engine's tables.
+        def write(n, pools):
             pk, pv = pools
-            start = (blk[i], 0, off[i], 0)
-            pk = jax.lax.dynamic_update_slice(pk, kt[i][None, :, None, :], start)
-            pv = jax.lax.dynamic_update_slice(pv, vt[i][None, :, None, :], start)
+            i, j = n // s, n % s
+            p = pos[i] + j
+            lb = p // bs_blk
+            blk = jnp.where(lb < mb, bt[i, jnp.minimum(lb, mb - 1)], 0)
+            start = (blk, 0, p % bs_blk, 0)
+            sk = jax.lax.dynamic_slice_in_dim(kt[i], j, 1, axis=1)[None]
+            sv = jax.lax.dynamic_slice_in_dim(vt[i], j, 1, axis=1)[None]
+            pk = jax.lax.dynamic_update_slice(pk, sk, start)
+            pv = jax.lax.dynamic_update_slice(pv, sv, start)
             return pk, pv
 
-        pool_k, pool_v = jax.lax.fori_loop(0, b, write, (pool_k, pool_v))
+        pool_k, pool_v = jax.lax.fori_loop(0, b * s, write, (pool_k, pool_v))
         ck.value, cv.value = pool_k, pool_v
         return pool_k, pool_v, bt, pos
     if positions is not None:
-        if s != 1:
-            raise ValueError(
-                f"per-row-position decode is single-token (got chunk {s}); "
-                "prefill chunks go through the scalar-cursor path"
-            )
         pos = jnp.asarray(positions, jnp.int32)
         if pos.shape != (b,):
             raise ValueError(f"positions must be [{b}], got {pos.shape}")
         if pre_update is not None:
             k, v = pre_update(k, v, pos)
         if initialized:
-            # per-row write as a one-hot select, NOT a gather-scatter
-            # (`.at[arange, :, pos, :].set`): XLA updates the select
-            # in-place on the donated buffer and fuses it, while the
-            # scatter blocks the in-place path and copies every layer's
-            # full [B, H, max_len, dh] buffer — measured 24.6 vs 8.9 ms
-            # per 4-layer step at the serving shapes on CPU
-            onehot = (
-                jnp.arange(max_len)[None, :] == pos[:, None]
-            )[:, None, :, None]  # [B, 1, max_len, 1]
-            ck.value = jnp.where(onehot, k.transpose(0, 2, 1, 3), ck.value)
-            cv.value = jnp.where(onehot, v.transpose(0, 2, 1, 3), cv.value)
+            # per-row write as a one-hot select (one per chunk entry), NOT
+            # a gather-scatter (`.at[arange, :, pos, :].set`): XLA updates
+            # the select in-place on the donated buffer and fuses it,
+            # while the scatter blocks the in-place path and copies every
+            # layer's full [B, H, max_len, dh] buffer — measured 24.6 vs
+            # 8.9 ms per 4-layer step at the serving shapes on CPU. An
+            # entry at pos + i >= max_len has an all-false one-hot: the
+            # write self-clamps (nothing lands, nothing is clobbered).
+            kt = k.transpose(0, 2, 1, 3)  # [B, H, s, dh]
+            vt = v.transpose(0, 2, 1, 3)
+            for i in range(s):
+                onehot = (
+                    jnp.arange(max_len)[None, :] == (pos + i)[:, None]
+                )[:, None, :, None]  # [B, 1, max_len, 1]
+                ck.value = jnp.where(onehot, kt[:, :, i : i + 1], ck.value)
+                cv.value = jnp.where(onehot, vt[:, :, i : i + 1], cv.value)
         slots = jnp.arange(max_len)[None, None, None, :]
-        mask = slots <= pos[:, None, None, None]  # [B, 1, 1, max_len]
+        # causal within the chunk, per-row: slot t attendable by row b's
+        # chunk entry i iff t <= pos_b + i
+        rows = pos[:, None, None, None] + jnp.arange(s)[None, None, :, None]
+        mask = slots <= rows  # [B, 1, s, max_len]
         return ck.value, cv.value, mask, pos
     pos = ci.value
     if pre_update is not None:
@@ -358,8 +368,11 @@ def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     block leaves the final answer in the revisited output block."""
     b_i = pl.program_id(0)
     j = pl.program_id(1)
+    s_q = q_ref.shape[1]
     pos = pos_ref[b_i]
-    last = pos // bs
+    # the chunk's LAST query row (pos + s_q - 1) bounds the block walk —
+    # for the single-token case this is the old pos // bs
+    last = (pos + s_q - 1) // bs
 
     @pl.when(j == 0)
     def _init():
@@ -370,16 +383,18 @@ def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j <= last)
     def _block():
         def one(i, _):
-            q = q_ref[i]  # [1, dh]
+            q = q_ref[i]  # [s_q, dh]
             k = k_ref[i // ratio]  # [bs, dh]
             v = v_ref[i // ratio]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * sm_scale  # [1, bs]
+            ) * sm_scale  # [s_q, bs]
             kp = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
-            s = jnp.where(kp <= pos, s, NEG_INF)
-            m_prev = m_ref[i]  # [1]
+            # causal within the chunk: query row r attends slots <= pos + r
+            rq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(kp <= pos + rq, s, NEG_INF)
+            m_prev = m_ref[i]  # [s_q]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
             alpha = jnp.exp(m_prev - m_new)
             p = jnp.exp(s - m_new[:, None])
@@ -387,11 +402,14 @@ def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
             pv = jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )  # [1, dh]
+            )  # [s_q, dh]
             acc_new = alpha[:, None] * acc_ref[i] + pv
             m_ref[i], l_ref[i], acc_ref[i] = m_new, l_new, acc_new
-            # j <= last guarantees at least one unmasked slot in this
-            # block (j*bs <= pos), so l_new > 0 — no guard needed
+            # block 0 has at least one unmasked slot for EVERY query row
+            # (slot 0 <= pos + r always), so after the j=0 step l > 0 for
+            # all rows — no guard needed. Later blocks fully masked for an
+            # early row contribute exp(NEG_INF - m) = 0 and leave its
+            # running stats unchanged.
             o_ref[i] = (acc_new / l_new[:, None]).astype(o_ref.dtype)
             return 0
 
@@ -416,19 +434,21 @@ def _paged_fused_attention(q, k_pool, v_pool, block_tables, positions):
     b, s_q, h, dh = q.shape
     h_kv, bs = k_pool.shape[1], k_pool.shape[2]
     mb = block_tables.shape[1]
-    if s_q != 1:
-        raise NotImplementedError("paged decode attention is single-token")
     if h % h_kv:
         raise NotImplementedError(f"q heads {h} not a multiple of kv {h_kv}")
     ratio = h // h_kv
     sm_scale = 1.0 / float(np.sqrt(dh))
-    qt = q.reshape(b, h, 1, dh)
+    # head-major for the kernel; s_q == 1 makes this a free reshape
+    qt = q.reshape(b, h, 1, dh) if s_q == 1 else q.transpose(0, 2, 1, 3)
 
     def kv_map(b_i, j, bt, pos):
-        jc = jnp.minimum(j, pos[b_i] // bs)
-        return (bt[b_i, jc], 0, 0, 0)
+        # clamp to the chunk's last needed block AND the table's extent
+        # (a verify chunk's tail past the mapped window re-walks the last
+        # block; its slots are masked in-kernel)
+        jc = jnp.minimum(j, (pos[b_i] + s_q - 1) // bs)
+        return (bt[b_i, jnp.minimum(jc, mb - 1)], 0, 0, 0)
 
-    q_spec = pl.BlockSpec((None, h, 1, dh), lambda b_i, j, *_: (b_i, 0, 0, 0))
+    q_spec = pl.BlockSpec((None, h, s_q, dh), lambda b_i, j, *_: (b_i, 0, 0, 0))
     kv_spec = pl.BlockSpec((None, h_kv, bs, dh), kv_map)
     out = pl.pallas_call(
         functools.partial(
@@ -440,9 +460,9 @@ def _paged_fused_attention(q, k_pool, v_pool, block_tables, positions):
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=q_spec,
             scratch_shapes=[
-                pltpu.VMEM((h, 1), jnp.float32),   # running max
-                pltpu.VMEM((h, 1), jnp.float32),   # running denominator
-                pltpu.VMEM((h, 1, dh), jnp.float32),  # running numerator
+                pltpu.VMEM((h, s_q), jnp.float32),   # running max
+                pltpu.VMEM((h, s_q), jnp.float32),   # running denominator
+                pltpu.VMEM((h, s_q, dh), jnp.float32),  # running numerator
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
@@ -452,14 +472,17 @@ def _paged_fused_attention(q, k_pool, v_pool, block_tables, positions):
         jnp.asarray(positions, jnp.int32),
         qt, k_pool, v_pool,
     )
-    return out.reshape(b, s_q, h, dh)
+    return out.reshape(b, s_q, h, dh) if s_q == 1 else out.transpose(0, 2, 1, 3)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
                            impl: str = "paged"):
-    """Single-token attention over the PAGED pool from :func:`cached_kv`'s
-    block-table mode (``q [B, 1, H, dh]`` activation layout, pools
-    head-major ``[n_blocks, H_kv, block_size, dh]``).
+    """Attention over the PAGED pool from :func:`cached_kv`'s block-table
+    mode (``q [B, s, H, dh]`` activation layout, pools head-major
+    ``[n_blocks, H_kv, block_size, dh]``). ``s == 1`` is the sampling
+    step; ``s > 1`` is the speculative-decoding verify chunk — causal
+    within the chunk (query row ``r`` attends logical slots
+    ``<= pos + r``), the multi-row twin of the contiguous per-row mask.
 
     ``impl="paged"`` runs the one-launch-per-layer Pallas kernel
     (:func:`_paged_fused_attention`): unlike the contiguous fused kernel
@@ -472,8 +495,7 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
     gather-then-dense oracle the kernel is tested against (and the
     correctness path on models pinned to ``attn_impl="xla"``)."""
     paged_ok = (
-        q.shape[1] == 1
-        and q.shape[2] % k_pool.shape[1] == 0
+        q.shape[2] % k_pool.shape[1] == 0
         # one block's K+V panel stays far under VMEM at any sane
         # block_size; no panel bound needed (the whole point: the DMA
         # unit is a block, not a row's full window)
@@ -482,8 +504,9 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
         return _paged_fused_attention(q, k_pool, v_pool, block_tables,
                                       positions)
     # dense oracle: gather each row's table into a contiguous window and
-    # reuse the contiguous dense path (per-row mask over slots <= pos)
-    b = q.shape[0]
+    # reuse the contiguous dense path (per-row causal-within-chunk mask
+    # over logical slots <= pos + row)
+    b, s_q = q.shape[0], q.shape[1]
     h_kv, bs = k_pool.shape[1], k_pool.shape[2]
     mb = block_tables.shape[1]
     bt = jnp.asarray(block_tables, jnp.int32)
@@ -491,5 +514,6 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
     keys = k_pool[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, mb * bs, -1)
     values = v_pool[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, mb * bs, -1)
     slots = jnp.arange(mb * bs)[None, None, None, :]
-    mask = slots <= pos[:, None, None, None]
+    rows = pos[:, None, None, None] + jnp.arange(s_q)[None, None, :, None]
+    mask = slots <= rows  # [B, 1, s_q, mb*bs]
     return decode_attention(q, keys, values, mask, pos, impl="xla")

@@ -203,6 +203,37 @@ class ParallelPlan:
         )
         return any(s is not None for s in spec)
 
+    def _mirror_overlay(self, spec, ref) -> NamedSharding:
+        """Sharding for an opt-state mirror ZeRO-1 SKIPPED (a leaf the
+        plan fsdp-scatters): the fsdp spec, UPGRADED to shard the same
+        dim over ``('fsdp', 'data')`` jointly when it divides — the
+        ZeRO-1 overlay for the leaves the pad/reshape path must not
+        touch. The mirror bytes shrink another ``data``× while the PARAM
+        keeps its plain fsdp layout (weights are read every forward;
+        mirrors only at the update, where GSPMD's reduce-scatter already
+        pays the data-axis traffic). Metadata-sharded (TP/PP) mirrors
+        and non-divisible or small leaves keep :meth:`_leaf_sharding`'s
+        answer untouched."""
+        base = self._leaf_sharding(spec, ref)
+        if self.data <= 1 or self.fsdp <= 1:
+            return base
+        if spec_is_sharded(spec if isinstance(spec, P) else P(), self.mesh):
+            return base
+        shape = tuple(
+            ref.shape if hasattr(ref, "shape") else np.shape(ref)
+        )
+        fs = largest_divisible_spec(
+            shape, FSDP_AXIS, self.fsdp, min_size=self.fsdp_min_size
+        )
+        if FSDP_AXIS not in fs:
+            return base  # small/indivisible: replicated either way
+        i = list(fs).index(FSDP_AXIS)
+        if shape[i] % (self.fsdp * self.data):
+            return base
+        new = list(fs)
+        new[i] = (FSDP_AXIS, DATA_AXIS)
+        return NamedSharding(self.mesh, P(*new))
+
     def wrap_zero1(self, tx):
         """ZeRO-1 optimizer-state sharding composed with this plan:
         ``optim.shard_state`` over ``data``, skipping the leaves the plan
@@ -246,7 +277,7 @@ class ParallelPlan:
             treedef = jax.tree_util.tree_structure(zero1)
             out = [
                 z if spec_is_sharded(getattr(z, "spec", P()), self.mesh)
-                else self._leaf_sharding(spec, ref)
+                else self._mirror_overlay(spec, ref)
                 for z, ref, spec in zip(
                     jax.tree_util.tree_leaves(zero1),
                     treedef.flatten_up_to(stored),

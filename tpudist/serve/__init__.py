@@ -24,10 +24,17 @@ keeps the decode batch full instead:
   per-slot sampling/stop params, one compiled masked decode step over the
   full slot batch, per-step streaming delivery, optional deploy-time AOT
   program cache (``compile_cache=``).
+- :mod:`tpudist.serve.spec` — speculative decoding
+  (``ServeEngine(draft_model=...)``): a cheap draft proposes ``spec_k``
+  tokens per slot per tick, the target verifies the whole window in ONE
+  bulk pass, and acceptance-rejection sampling preserves the target
+  distribution exactly — greedy output stays token-identical to the
+  non-speculative engine (docs/SERVING.md §6, docs/PERF.md §7d).
 - :mod:`tpudist.serve.stats` — TTFT/TPOT percentiles, queue depth, slot
   utilization, block-pool occupancy / prefix hit rate / preemptions,
-  tokens/s as ``serve`` JSONL rows through the telemetry sink
-  (docs/OBSERVABILITY.md; architecture in docs/SERVING.md).
+  speculative acceptance rate, tokens/s as ``serve`` JSONL rows through
+  the telemetry sink (docs/OBSERVABILITY.md; architecture in
+  docs/SERVING.md).
 
 Quick start::
 
@@ -48,6 +55,11 @@ from tpudist.serve.engine import (
 )
 from tpudist.serve.prefill import Prefiller
 from tpudist.serve.slots import SlotPool, write_slot
+from tpudist.serve.spec import (
+    cache_bytes,
+    early_exit_draft,
+    speculative_accept,
+)
 from tpudist.serve.stats import ServeStats
 
 __all__ = [
@@ -63,4 +75,7 @@ __all__ = [
     "PagedSlotPool",
     "PrefixCache",
     "ServeStats",
+    "speculative_accept",
+    "early_exit_draft",
+    "cache_bytes",
 ]

@@ -463,6 +463,23 @@ class PagedSlotPool:
         self.fill[slot] += 1
         return True
 
+    def ensure_to(self, slot: int, n_tokens: int) -> bool:
+        """Map blocks until the slot's table covers ``n_tokens`` positions
+        (clamped to the table's extent); ``False`` when the pool runs dry
+        mid-way (already-mapped blocks stay mapped — the engine escalates
+        and retries). The SPECULATIVE dispatch path: a verify sweep writes
+        up to ``spec_k + 1`` tokens past a cursor the host only knows one
+        fetch late, so the engine maps the whole conservative window at
+        once instead of one ``ensure_next`` per emitted token."""
+        need = min(self.blocks_for(n_tokens), self.max_blocks)
+        while int(self.fill[slot]) < need:
+            b = self.blocks.alloc()
+            if b is None:
+                return False
+            self.tables[slot, self.fill[slot]] = b
+            self.fill[slot] += 1
+        return True
+
     def advance(self, slot: int) -> None:
         """One decode step wrote this slot's token at its cursor; bump it."""
         self.positions[slot] += 1
@@ -498,3 +515,18 @@ class PagedSlotPool:
         return jax.tree_util.tree_map(
             lambda l: jnp.zeros(l.shape, l.dtype) if l.ndim != 4 else l, row
         )
+
+
+def draft_equivalent_blocks(model, draft_model, max_slots: int,
+                            block_size: int) -> int:
+    """How many TARGET-model KV blocks the draft pool's bytes buy — the
+    equal-HBM handicap for the speculative-vs-autoregressive A/B
+    (bench.py's ``spec`` leg): the speculative engine allocates a full
+    contiguous draft SlotPool on top of its paged target pool, so the
+    honest baseline gives the plain engine that many EXTRA target blocks
+    instead. Rounds up (the baseline gets the benefit of the doubt)."""
+    from tpudist.serve.spec import cache_bytes
+
+    per_token = cache_bytes(model, 1) // model.max_seq_len
+    draft = cache_bytes(draft_model, max_slots)
+    return -(-draft // max(per_token * block_size, 1))

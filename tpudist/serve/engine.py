@@ -60,6 +60,24 @@ Three scheduler behaviors only exist there:
   same cursor, rng stream, and sampling state — greedy output stays
   bit-identical through an eviction cycle, pinned by test).
 
+**Speculative mode** (``draft_model=``, docs/SERVING.md §6): each tick a
+cheap DRAFT model proposes ``spec_k`` tokens per live slot (K+1
+single-token draft steps against a second, slot-pinned draft KV pool),
+and the target scores the whole window ``[last, d_1..d_K]`` in ONE bulk
+decode pass — the accepted prefix plus one correction/bonus token all
+land in a single target weight sweep, so a slot emits up to ``spec_k+1``
+tokens per tick at roughly one sequential-pass cost (docs/PERF.md §7d:
+fewer passes beats faster passes). Acceptance-rejection sampling
+(:mod:`tpudist.serve.spec`) preserves the target distribution EXACTLY —
+greedy speculative output is token-identical to the non-speculative
+engine, pinned by test. The cursor becomes DEVICE-carried (``[S]``
+positions ride the step outputs, since only the device knows how many
+tokens each sweep accepted); the host's view syncs at each delayed
+fetch, lagging at most two sweeps — the paged block-mapping horizon
+covers ``2·(spec_k+1)`` tokens of that lag. "Rollback" of rejected
+draft K/V is pure cursor bookkeeping: stale entries above the cursor
+are overwritten before the causal mask ever admits them.
+
 **Priority lanes**: ``submit(priority=N)`` — admission always serves the
 highest-priority non-empty lane, FIFO within a lane, UNLESS
 ``ttft_slo_s`` is set and a lower lane's head has waited past it (then
@@ -143,6 +161,168 @@ class _Inflight:
     done: jax.Array
     live: np.ndarray   # [S] bool — rows fed for real at this dispatch
     rid: np.ndarray    # [S] int64 — owner snapshot
+
+
+@dataclasses.dataclass
+class _SpecInflight:
+    """A dispatched-but-unfetched SPECULATIVE sweep: the device futures
+    for the emitted window (``emit [S, K+1]`` / ``n_emit [S]``), the
+    eligible-draft counts (``n_spec`` — acceptance-rate telemetry), the
+    advanced cursors (``pos`` — the host's position sync), the eos flags,
+    and the same ownership snapshot the plain pipeline keys its zombie
+    guard on."""
+
+    emit: jax.Array
+    n_emit: jax.Array
+    n_spec: jax.Array
+    pos: jax.Array
+    done: jax.Array
+    live: np.ndarray   # [S] bool — rows fed for real at this dispatch
+    rid: np.ndarray    # [S] int64 — owner snapshot
+
+
+def _build_spec_step(model, params, draft_model, draft_params, base_key,
+                     spec_k: int, paged: bool):
+    """The one compiled SPECULATIVE step over the full slot batch:
+    ``spec_k`` single-token draft proposals (plus one priming step so a
+    fully-accepted window's K/V is complete), ONE bulk target verify pass
+    over ``[last, d_1..d_K]``, acceptance-rejection
+    (:func:`tpudist.serve.spec.speculative_accept`), and an in-graph
+    first-EOS cut. Both caches are donated; the cursor and last-token
+    lanes are device-carried outputs (only the device knows each row's
+    acceptance count).
+
+    Per-row clamps make one formula cover sequence end AND budget:
+    ``limit = prompt_len + max_new_tokens`` rides in as a device input,
+    ``allowed = limit - 1 - pos`` is how many tokens the row may still
+    emit, and ``n_spec = clip(allowed - 1, 0, K)`` caps eligibility so
+    ``n_emit <= allowed`` — the device NEVER overshoots a budget, which
+    is what keeps the paged block-mapping horizon inside the worst case
+    ``submit()`` already validated (no admission livelock). Draft/verify
+    writes past the clamp land above the cursor (contiguous: the one-hot
+    write self-clamps past ``max_seq_len``; paged: unmapped table
+    entries redirect to the garbage block) and rows past ``n_spec`` are
+    never consumed, so the overshoot is dead weight, not corruption.
+
+    RNG: one key per (request, cursor) — ``fold(fold(base, rid), pos)``;
+    draft step ``i`` folds salt ``i``, acceptance/residual use the
+    disjoint salts in :mod:`tpudist.serve.spec`. ``pos`` is strictly
+    increasing and replay-stable, so a preempted request re-draws the
+    same stream; ``pos >= 1`` (prompts are non-empty) keeps the space
+    disjoint from ``_first_token``'s token-index-0 keys."""
+    from tpudist.serve.spec import speculative_accept
+
+    K = int(spec_k)
+
+    def body(cache, d_cache, prev_tok, override_tok, use_override, pos_in,
+             override_pos, done, req_ids, temperature, top_k, top_p, eos,
+             limit, block_tables=None):
+        extra = {} if block_tables is None else {"block_tables": block_tables}
+        tok0 = jnp.where(use_override, override_tok, prev_tok)
+        pos = jnp.where(use_override, override_pos, pos_in).astype(jnp.int32)
+        allowed = limit - 1 - pos          # tokens this row may still emit
+        n_spec = jnp.clip(allowed - 1, 0, K).astype(jnp.int32)
+        alive = (~done) & (allowed > 0)
+
+        keys = jax.vmap(
+            lambda r, p: jax.random.fold_in(jax.random.fold_in(base_key, r), p)
+        )(req_ids, pos)
+
+        # K draft proposals, each a masked single-token step at its own
+        # per-row position (the draft pool rides the SAME slot/cursor
+        # lanes as the target), sampled from the draft's WARPED
+        # distribution — the distribution the acceptance ratio divides by
+        cur, d_toks, d_logits = tok0, [], []
+        for i in range(K):
+            dl, dup = draft_model.apply(
+                {"params": draft_params, "cache": d_cache}, cur[:, None],
+                train=False, decode=True, mutable=["cache"],
+                positions=pos + i,
+            )
+            d_cache = dup["cache"]
+            ki = jax.vmap(lambda kk: jax.random.fold_in(kk, i))(keys)
+            cur = sample_logits_per_row(
+                dl[:, -1], ki, temperature=temperature, top_k=top_k,
+                top_p=top_p,
+            )
+            d_toks.append(cur)
+            d_logits.append(dl[:, -1])
+        if K:
+            # prime d_K's draft K/V (logits discarded — return_hidden
+            # skips the head): after a FULLY accepted window the next
+            # tick feeds the bonus token at pos+K+1, and the draft must
+            # attend d_K at pos+K
+            _, dup = draft_model.apply(
+                {"params": draft_params, "cache": d_cache}, cur[:, None],
+                train=False, decode=True, mutable=["cache"],
+                positions=pos + K, return_hidden=True,
+            )
+            d_cache = dup["cache"]
+        d_toks_a = jnp.stack(d_toks, axis=1)      # [S, K]
+        d_logits_a = jnp.stack(d_logits, axis=1)  # [S, K, V]
+
+        # ONE bulk target pass scores the whole window [tok0, d_1..d_K]:
+        # K+1 rows of target logits from a single weight sweep, writing
+        # every window token's K/V at its own per-row position in the
+        # same pass (accepted tokens' K/V is already in place next tick;
+        # rejected tokens' K/V sits above the cursor, dead)
+        window = jnp.concatenate([tok0[:, None], d_toks_a], axis=1)
+        t_logits, updates = model.apply(
+            {"params": params, "cache": cache}, window,
+            train=False, decode=True, mutable=["cache"], positions=pos,
+            **extra,
+        )
+        cache = updates["cache"]
+        emit, n_emit = speculative_accept(
+            t_logits, d_logits_a, d_toks_a, n_spec, keys,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+
+        # in-graph first-EOS cut (the window analog of eos_retire): keep
+        # through the first stop token, flag the row for retirement
+        cols = jnp.arange(K + 1)[None, :]
+        is_eos = (emit == eos[:, None]) & (eos >= 0)[:, None] & (
+            cols < n_emit[:, None]
+        )
+        first_eos = jnp.min(
+            jnp.where(is_eos, cols, K + 1), axis=1
+        ).astype(jnp.int32)
+        n_emit = jnp.minimum(n_emit, first_eos + 1)
+        eos_hit = first_eos < n_emit
+        n_emit = jnp.where(alive, n_emit, 0)
+        n_spec = jnp.where(alive, n_spec, 0)
+        emit = jnp.where(cols < n_emit[:, None], emit, 0)
+        done_out = done | (alive & eos_hit)
+
+        new_pos = pos + n_emit
+        last = jnp.take_along_axis(
+            emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+        )[:, 0]
+        next_tok = jnp.where(n_emit > 0, last, tok0)
+        return (cache, d_cache, new_pos, next_tok, emit, n_emit, n_spec,
+                done_out)
+
+    if paged:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(cache, d_cache, prev_tok, override_tok, use_override,
+                 pos_in, override_pos, block_tables, done, req_ids,
+                 temperature, top_k, top_p, eos, limit):
+            return body(cache, d_cache, prev_tok, override_tok,
+                        use_override, pos_in, override_pos, done, req_ids,
+                        temperature, top_k, top_p, eos, limit,
+                        block_tables=block_tables)
+
+        return step
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(cache, d_cache, prev_tok, override_tok, use_override, pos_in,
+             override_pos, done, req_ids, temperature, top_k, top_p, eos,
+             limit):
+        return body(cache, d_cache, prev_tok, override_tok, use_override,
+                    pos_in, override_pos, done, req_ids, temperature,
+                    top_k, top_p, eos, limit)
+
+    return step
 
 
 def _build_decode_step(model, params, base_key, paged: bool):
@@ -272,9 +452,31 @@ class ServeEngine:
                  paged: bool = False, block_size: int = 32,
                  n_blocks: int | None = None, prefix_cache: bool = True,
                  watermark_blocks: int | None = None,
-                 ttft_slo_s: float | None = None, compile_cache=None):
+                 ttft_slo_s: float | None = None, compile_cache=None,
+                 draft_model=None, draft_params=None, spec_k: int = 4):
         self.model = model
         self.params = params
+        self.spec = draft_model is not None
+        self.spec_k = int(spec_k)
+        if self.spec:
+            if draft_params is None:
+                raise ValueError("draft_model given without draft_params")
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if getattr(draft_model, "vocab_size", None) != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {getattr(draft_model, 'vocab_size', None)} "
+                    f"!= target vocab {model.vocab_size} — the acceptance "
+                    "ratio compares per-token distributions"
+                )
+            if draft_model.max_seq_len < model.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_model.max_seq_len} < target's "
+                    f"{model.max_seq_len}: the draft pool rides the target's "
+                    "cursor lane and must cover the same positions"
+                )
+        self.draft_model = draft_model
+        self.draft_params = draft_params
         self.max_active = max_slots if max_active is None else max_active
         if not 1 <= self.max_active <= max_slots:
             raise ValueError(
@@ -308,9 +510,26 @@ class ServeEngine:
             paged=self.paged,
         )
         self._base_key = jax.random.key(seed)
-        self._decode_fn = _build_decode_step(
-            model, params, self._base_key, self.paged
-        )
+        if self.spec:
+            # second, slot-pinned KV pool for the draft (contiguous even
+            # under a paged target — the draft cache is small enough to
+            # pay its full rectangle; equal-HBM comparisons account for
+            # it via blocks.draft_equivalent_blocks) plus a HEADLESS
+            # draft prefiller: the draft's first proposal conditions on
+            # the target-sampled first token, so its prompt-end logits
+            # are never read
+            self._draft_pool = SlotPool(draft_model, max_slots)
+            self._draft_prefiller = Prefiller(
+                draft_model, draft_params, chunk=prefill_chunk, head=False,
+            )
+            self._decode_fn = _build_spec_step(
+                model, params, draft_model, draft_params, self._base_key,
+                self.spec_k, self.paged,
+            )
+        else:
+            self._decode_fn = _build_decode_step(
+                model, params, self._base_key, self.paged
+            )
         self._lanes: dict[int, collections.deque[Request]] = {}
         self._t_submit: dict[int, float] = {}
         self.retain_results = retain_results
@@ -338,6 +557,11 @@ class ServeEngine:
         # that splice a new request's first token into its slot's lane
         self._prev_tok = jnp.zeros(s, jnp.int32)
         self._override: dict[int, int] = {}
+        # speculative device-carried cursor lane + per-slot emission limit
+        # (prompt_len + max_new — the spec step's one clamp covering both
+        # sequence end and budget); host positions sync at each fetch
+        self._pos_dev = jnp.zeros(s, jnp.int32)
+        self._limit = np.zeros(s, np.int32)
         self._inflight: _Inflight | None = None
         self._drained_events: list[TokenEvent] = []
         self._decode_aot: dict | None = None
@@ -640,6 +864,14 @@ class ServeEngine:
                     self.pool.blocks.decref(int(blk))
             else:
                 slot = self.pool.insert(row_cache, len(kv_tokens))
+            if self.spec:
+                # the draft's K/V for the same window, pinned to the SAME
+                # slot (shared cursor lane). Always a real prefill — the
+                # draft has no paged pool or prefix cache to resume from,
+                # and headless chunks on a narrow model are cheap
+                d_row, _ = self._draft_prefiller(kv_tokens)
+                self._draft_pool.write_row(d_row, slot)
+                self._limit[slot] = len(req.prompt) + req.max_new_tokens
             self._req[slot] = req.request_id
             self._dispatched[slot] = n_disp
             self._budget[slot] = req.max_new_tokens
@@ -717,11 +949,143 @@ class ServeEngine:
                 live[victim] = False
         return live
 
-    def _dispatch(self) -> _Inflight | None:
+    def _ensure_blocks_spec(self, live: np.ndarray) -> np.ndarray:
+        """Paged pre-dispatch pass, speculative flavor: one sweep writes
+        up to ``spec_k + 1`` positions past a cursor the host only knows
+        ONE FETCH LATE (the in-flight sweep may have advanced it another
+        ``spec_k + 1``), so each live slot maps a whole window — host
+        cursor + ``2·(spec_k+1)`` tokens, capped at the slot's emission
+        limit, which ``submit()`` already validated fits the pool — via
+        :meth:`tpudist.serve.blocks.PagedSlotPool.ensure_to`. Dry-pool
+        escalation is the same ladder as the plain path: force-fetch the
+        in-flight sweep (retirements free blocks AND tighten the horizon,
+        since the host cursor catches up), evict a cold prefix leaf,
+        preempt the newest lowest-priority slot."""
+        horizon = 2 * (self.spec_k + 1)
+        for slot in np.nonzero(live)[0]:
+            while live[slot]:
+                need = min(
+                    int(self.pool.positions[slot]) + horizon,
+                    int(self._limit[slot]),
+                )
+                if self.pool.ensure_to(slot, need):
+                    break
+                if self._inflight is not None:
+                    self._drained_events.extend(
+                        self._process(self._inflight)
+                    )
+                    self._inflight = None
+                    live &= self.pool.active
+                    continue
+                if self.pool.evict_prefix(1):
+                    continue
+                victim = self._choose_victim()
+                if victim is None:  # no active slots left to free
+                    live[slot] = False
+                    break
+                self._preempt(victim)
+                live[victim] = False
+        return live
+
+    def _dispatch_spec(self) -> _SpecInflight | None:
+        """The speculative analog of :meth:`_dispatch`: live rows are
+        simply the occupied slots — budget gating moved ON DEVICE (the
+        step's ``limit`` clamp emits zero once a row is exhausted, so an
+        over-dispatched zombie sweep is dead weight, and the host retires
+        the slot at the fetch that consumes its budget). The cursor lane
+        is device-carried (``_pos_dev`` chains through the step outputs);
+        admission overrides splice a fresh slot's cursor in exactly like
+        its first token."""
+        live = self.pool.active.copy()
+        if self.paged and live.any():
+            live = self._ensure_blocks_spec(live)
+        if not live.any():
+            return None
+        s = self.pool.max_slots
+        override_tok = np.zeros(s, np.int32)
+        override_pos = np.zeros(s, np.int32)
+        use_override = np.zeros(s, bool)
+        for slot, tok in self._override.items():
+            override_tok[slot] = tok
+            override_pos[slot] = self.pool.positions[slot]
+            use_override[slot] = True
+        self._override.clear()
+        # same snapshot discipline as _dispatch: every host array copies
+        # before becoming a device argument (XLA:CPU zero-copy aliasing)
+        args = [
+            self.pool.cache, self._draft_pool.cache, self._prev_tok,
+            jnp.asarray(override_tok), jnp.asarray(use_override),
+            self._pos_dev, jnp.asarray(override_pos),
+        ]
+        if self.paged:
+            args.append(jnp.asarray(self.pool.tables.copy()))
+        args += [
+            jnp.asarray(~live), jnp.asarray(self._req.astype(np.int32)),
+            jnp.asarray(self._temp.copy()), jnp.asarray(self._topk.copy()),
+            jnp.asarray(self._topp.copy()), jnp.asarray(self._eos.copy()),
+            jnp.asarray(self._limit.copy()),
+        ]
+        (self.pool.cache, self._draft_pool.cache, new_pos, next_tok, emit,
+         n_emit, n_spec, done_dev) = self._call_decode(*args)
+        self._pos_dev = new_pos
+        self._prev_tok = next_tok
+        return _SpecInflight(
+            emit, n_emit, n_spec, new_pos, done_dev, live, self._req.copy()
+        )
+
+    def _process_spec(self, prev: _SpecInflight) -> list[TokenEvent]:
+        """Fetch a speculative sweep (the one host sync per tick): stream
+        each owned row's emitted window IN ORDER (every token its own
+        :class:`TokenEvent` — the consumer-visible contract is unchanged,
+        there are just up to ``spec_k + 1`` per slot per tick), sync the
+        host cursor from the device's, and retire on the in-graph EOS
+        flag or the budget landing exactly on the window's last token
+        (the device clamp guarantees no mid-window overshoot)."""
+        emit = np.asarray(prev.emit)
+        n_emit = np.asarray(prev.n_emit)
+        n_spec = np.asarray(prev.n_spec)
+        pos = np.asarray(prev.pos)
+        done = np.asarray(prev.done)
+        events: list[TokenEvent] = []
+        drafted = accepted = 0
+        for slot in np.nonzero(prev.live)[0]:
+            rid = int(prev.rid[slot])
+            if self._req[slot] != rid or rid not in self._counts:
+                continue  # zombie sweep: ownership guard, as in _process
+            self.pool.positions[slot] = int(pos[slot])
+            m = int(n_emit[slot])
+            if m == 0:
+                continue
+            # accepted = emitted minus the one correction/bonus token the
+            # target pass supplies anyway; drafted = ELIGIBLE proposals
+            # (the device's n_spec clamp), so a budget-clamped window
+            # doesn't read as rejection
+            drafted += int(n_spec[slot])
+            accepted += m - 1
+            for j in range(m):
+                n = self._counts[rid]
+                finished = (
+                    (bool(done[slot]) and j == m - 1)
+                    or n + 1 >= int(self._budget[slot])
+                )
+                events.append(self._emit(rid, int(emit[slot, j]), finished))
+                if finished:
+                    self._finish(rid)
+                    self.pool.release(slot)
+                    self._req[slot] = -1
+                    self._slot_req.pop(slot, None)
+                    break
+        self.stats.on_decode_step(int(prev.live.sum()), len(events))
+        self.stats.on_spec(drafted, accepted)
+        return events
+
+    def _dispatch(self) -> _Inflight | _SpecInflight | None:
         """Dispatch the next decode step without waiting on the previous
         one's results. Live rows = occupied slots with budget left; a slot
         whose stop token sits in the unfetched step rides one extra masked
         zombie row (discarded at process time by the ownership guard)."""
+        if self.spec:
+            return self._dispatch_spec()
         live = self.pool.active & (self._dispatched < self._budget)
         if self.paged and live.any():
             live = self._ensure_blocks(live)
@@ -761,9 +1125,11 @@ class ServeEngine:
             self._dispatched[slot] += 1
         return _Inflight(tok_dev, done_dev, live, self._req.copy())
 
-    def _process(self, prev: _Inflight) -> list[TokenEvent]:
+    def _process(self, prev) -> list[TokenEvent]:
         """Fetch a dispatched step's tokens (the ONE host sync per tick,
         one step behind the device) and stream/retire."""
+        if isinstance(prev, _SpecInflight):
+            return self._process_spec(prev)
         tok = np.asarray(prev.tok)
         done = np.asarray(prev.done)
         events: list[TokenEvent] = []
@@ -835,14 +1201,22 @@ class ServeEngine:
             "chunk": self.prefiller.chunk,
             "minimum": self.prefiller.minimum,
             "seed": seed,
+            # speculative geometry: the step program bakes in K and the
+            # draft architecture, and closes over the draft weights too
+            "spec_k": self.spec_k if self.spec else 0,
+            "draft": model_identity(self.draft_model) if self.spec else None,
         }
         h.update(json.dumps(cfg, sort_keys=True).encode())
-        flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
-        for path, leaf in flat:
-            arr = np.asarray(jax.device_get(leaf))
-            h.update(jax.tree_util.keystr(path).encode())
-            h.update(str(arr.dtype).encode())
-            h.update(arr.tobytes())
+        trees = [("", self.params)]
+        if self.spec:
+            trees.append(("draft/", self.draft_params))
+        for prefix, tree in trees:
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            for path, leaf in flat:
+                arr = np.asarray(jax.device_get(leaf))
+                h.update((prefix + jax.tree_util.keystr(path)).encode())
+                h.update(str(arr.dtype).encode())
+                h.update(arr.tobytes())
         return h.hexdigest()[:24]
 
     def _setup_compile_cache(self, directory, *, seed: int) -> None:
@@ -898,17 +1272,31 @@ class ServeEngine:
         s = self.pool.max_slots
         cache_ex = self.pool.cache
         i32 = lambda *shape: jnp.zeros(shape, jnp.int32)
-        decode_args = [
-            cache_ex, i32(s), i32(s), jnp.zeros(s, bool), i32(s),
-        ]
-        if self.paged:
-            decode_args.append(i32(s, self.pool.max_blocks))
-        decode_args += [
-            jnp.zeros(s, bool), i32(s), i32(s), jnp.zeros(s, jnp.float32),
-            i32(s), jnp.ones(s, jnp.float32), i32(s),
-        ]
-        self._decode_aot = {"exe": fetch("decode", self._decode_fn,
-                                         *decode_args)}
+        if self.spec:
+            decode_args = [
+                cache_ex, self._draft_pool.cache, i32(s), i32(s),
+                jnp.zeros(s, bool), i32(s), i32(s),
+            ]
+            if self.paged:
+                decode_args.append(i32(s, self.pool.max_blocks))
+            decode_args += [
+                jnp.zeros(s, bool), i32(s), jnp.zeros(s, jnp.float32),
+                i32(s), jnp.ones(s, jnp.float32), i32(s), i32(s),
+            ]
+            self._decode_aot = {"exe": fetch("spec", self._decode_fn,
+                                             *decode_args)}
+        else:
+            decode_args = [
+                cache_ex, i32(s), i32(s), jnp.zeros(s, bool), i32(s),
+            ]
+            if self.paged:
+                decode_args.append(i32(s, self.pool.max_blocks))
+            decode_args += [
+                jnp.zeros(s, bool), i32(s), i32(s), jnp.zeros(s, jnp.float32),
+                i32(s), jnp.ones(s, jnp.float32), i32(s),
+            ]
+            self._decode_aot = {"exe": fetch("decode", self._decode_fn,
+                                             *decode_args)}
         # _cache_shapes is already a ShapeDtypeStruct tree and sds() maps
         # it through unchanged — no device-side batch-1 cache allocation
         # just to describe shapes
@@ -930,5 +1318,17 @@ class ServeEngine:
         if exe is not None:
             aot[("body", self.prefiller.chunk)] = exe
         self.prefiller.attach_aot(aot)
+        if self.spec:
+            # the HEADLESS draft prefiller runs every chunk — including
+            # the bucketed final one — through its body program, so it
+            # needs a body executable at every bucket, not just `chunk`
+            dpf = self._draft_prefiller
+            d_row_ex = dpf._cache_shapes
+            d_aot = {}
+            for b in {*buckets, dpf.chunk}:
+                exe = fetch(f"dpb{b}", dpf._chunk_body, d_row_ex, i32(1, b))
+                if exe is not None:
+                    d_aot[("body", b)] = exe
+            dpf.attach_aot(d_aot)
         info["build_s"] = round(time.perf_counter() - t0, 6)
         self.compile_cache_info = info

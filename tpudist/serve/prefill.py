@@ -66,12 +66,22 @@ class Prefiller:
     per CALL, a per-admission tax the static path never sees because one
     ``generate()`` call amortizes it over the whole scan — and the fresh
     cache's eval_shape (a full model-init retrace, ~100 ms at 124M) runs
-    once here, not per request."""
+    once here, not per request.
 
-    def __init__(self, model, params, *, chunk: int = 512, minimum: int = 8):
+    ``head=False`` skips the LM head on the FINAL chunk too and returns
+    ``(row_cache, None)`` — the speculative DRAFT prefill
+    (``tpudist.serve.engine``): the draft only needs its prompt K/V (its
+    first proposal is conditioned on the target-sampled first token, so
+    its prompt-end logits are never read), and the head matmul +
+    ``[1, bucket, V]`` logits are the expensive part of a narrow model's
+    chunk."""
+
+    def __init__(self, model, params, *, chunk: int = 512, minimum: int = 8,
+                 head: bool = True):
         self.model = model
         self.chunk = min(int(chunk), model.max_seq_len)
         self.minimum = minimum
+        self.head = head
         if self.chunk < 1:
             raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
         self._cache_shapes = jax.eval_shape(
@@ -186,7 +196,7 @@ class Prefiller:
             toks = np.zeros((1, padded), np.int32)
             toks[0, :n] = prompt[off : off + n]
             toks = jnp.asarray(toks)
-            if i + 1 < len(plan):
+            if i + 1 < len(plan) or not self.head:
                 cache = self._run_chunk(cache, toks, final=False)
             else:
                 cache, logits = self._run_chunk(cache, toks, final=True)
@@ -196,4 +206,6 @@ class Prefiller:
         # cursors sit past p. The pool scatter copies only the 4-D buffers
         # (slots.write_slot) and the engine owns the slot's true length, so
         # the overshoot never escapes this function.
+        if not self.head:
+            return cache, None
         return cache, _index_logits(logits, jnp.asarray(last, jnp.int32))

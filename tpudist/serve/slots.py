@@ -97,6 +97,18 @@ class SlotPool:
         `pool_occupancy` separately; docs/OBSERVABILITY.md §1)."""
         return self.n_active / self.max_slots
 
+    def write_row(self, row_cache, slot: int) -> None:
+        """Scatter a prefilled batch-1 cache into a SPECIFIC slot row,
+        bypassing the pool's occupancy bookkeeping — the speculative
+        DRAFT cache (``tpudist.serve.engine``) is a second SlotPool whose
+        row-for-a-request is PINNED to whatever slot the target's
+        admission chose, and whose cursors are the engine's shared
+        per-slot position lane; this pool variant therefore keeps no
+        positions/active of its own."""
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(f"slot {slot} outside [0, {self.max_slots})")
+        self.cache = write_slot(self.cache, row_cache, slot)
+
     def insert(self, row_cache, true_len: int) -> int:
         """Scatter a prefilled batch-1 cache into a free slot; returns the
         slot index. Raises when the pool is full — the engine's admission

@@ -85,6 +85,15 @@ class ServeStats:
         # lifetime slot-occupancy accumulators (never reset — snapshot())
         self._life_active = 0
         self._life_steps = 0
+        # speculative-decoding counters (zero on a non-spec engine): the
+        # engine drives on_spec once per processed verify sweep; the
+        # acceptance rate is the live health reading of the draft — when
+        # it sags, speculation is burning draft FLOPs for nothing and the
+        # rate on the serve row says so (docs/OBSERVABILITY.md §1)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self._win_spec_drafted = 0
+        self._win_spec_accepted = 0
 
     # -- per-request lifecycle --------------------------------------------
 
@@ -131,6 +140,21 @@ class ServeStats:
 
     # -- per-step drive ----------------------------------------------------
 
+    def on_spec(self, drafted: int, accepted: int) -> None:
+        """One verify sweep's outcome across the batch: ``drafted`` =
+        eligible draft proposals scored, ``accepted`` = how many survived
+        the ratio test (bonus/correction tokens are NOT counted here —
+        they'd be emitted by a plain engine too, so counting them would
+        flatter the rate)."""
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        self._win_spec_drafted += drafted
+        self._win_spec_accepted += accepted
+
+    @staticmethod
+    def _rate(accepted: int, drafted: int) -> float | None:
+        return None if not drafted else round(accepted / drafted, 4)
+
     def on_decode_step(self, active: int, emitted: int) -> None:
         self.tokens += emitted
         self._win_tokens += emitted
@@ -147,6 +171,7 @@ class ServeStats:
         self.sink.write("serve", step, **self._window_row(queue_depth, active))
         self._win_t0 = self._clock()
         self._win_tokens = self._win_active = self._win_steps = 0
+        self._win_spec_drafted = self._win_spec_accepted = 0
 
     # -- readouts ----------------------------------------------------------
 
@@ -179,6 +204,14 @@ class ServeStats:
             ),
             "prefix_hit_rate": self.prefix_hit_rate,
             "preemptions": self.preemptions,
+            # speculative fields (docs/OBSERVABILITY.md §1): window-scoped
+            # like tokens_per_sec — the LIVE acceptance rate, not a
+            # lifetime average that smooths over a draft going stale
+            "spec_drafted": self._win_spec_drafted,
+            "spec_accepted": self._win_spec_accepted,
+            "spec_acceptance_rate": self._rate(
+                self._win_spec_accepted, self._win_spec_drafted
+            ),
         }
 
     def snapshot(self) -> dict:
@@ -204,6 +237,11 @@ class ServeStats:
             ),
             "prefix_hit_rate": self.prefix_hit_rate,
             "preemptions": self.preemptions,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_acceptance_rate": self._rate(
+                self.spec_accepted, self.spec_drafted
+            ),
         }
 
     def write_summary(self, step: int) -> None:
