@@ -155,6 +155,17 @@ Legs
    GPipe at equal (stages, microbatches) with the activation-memory
    delta recorded. Off-TPU the leg re-execs onto an emulated 8-CPU
    world: budgets identical, live legs labeled functional proofs.
+19. ``gpt2_6b_mc_serve_hbm_budget`` / ``gpt2_mc_serve_tokens_per_sec`` —
+   the multi-chip serving legs (docs/SERVING.md §7, PERF §7e): a ~6.6B
+   GPT-2 serving geometry (bf16 weights + 16-slot seq-2048 paged pool)
+   whose replicated bytes provably overflow 16 GB/chip but fit
+   tensor-sharded at tp=4 (weights per the engine's Megatron-metadata
+   shardings, pool split on the KV-head dim — exact eval_shape
+   accounting); and the tok/s A/B, ``ServeEngine(mesh=tensor-2)`` vs
+   single-chip at equal model + Poisson traffic, greedy output asserted
+   token-identical across topologies. Off-TPU the A/B re-execs onto an
+   emulated 8-CPU world as a functional proof (the aggregated-HBM gain
+   needs real ICI).
 Targets (the reference publishes nothing — BASELINE.md: ``published: {}``;
 the north star is ≥90% of the reference stack's per-chip rate on 8×A100):
 - ResNet-50: 2250 img/s/chip = 90% of ~2500 img/s for one A100 running
@@ -1514,6 +1525,239 @@ def bench_spec_serve() -> None:
     )
 
 
+def bench_mc_serve() -> None:
+    """Leg 19 (``mc_serve``, docs/SERVING.md §7 + PERF §7e): the
+    multi-chip serving legs. (1) **capacity** — a ~6.6B GPT-2 geometry
+    whose bf16 weights + production paged block pool provably overflow
+    one chip's 16 GB HBM replicated but fit tensor-sharded at ``tp=4``
+    (exact eval_shape accounting: weights per chip via the engine's own
+    ``engine_param_shardings`` + ``tpudist.memory.per_device_bytes``,
+    pool per chip via ``serve.spec.cache_bytes(tensor_world=)`` — the
+    KV-head-dim split). (2) **tok/s** — the A/B at equal model and
+    traffic, ``ServeEngine(mesh=tensor-2)`` vs single-chip, greedy paged
+    engines both sides, where §7's contract makes the sharded side's
+    output token-identical (asserted during warmup). Runs in-process on
+    a >=8-chip attach; otherwise re-execs onto an emulated 8-CPU world —
+    budgets identical, the A/B becomes a functional proof (two virtual
+    chips share ONE host's bandwidth, so the off-TPU ratio is expected
+    <1; the aggregated-HBM gain needs real ICI, PERF §7e)."""
+    import subprocess
+    import sys
+
+    if jax.device_count() >= 8:
+        _mc_serve_impl(emulated=False)
+        return
+    env = dict(os.environ)
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); import bench; "
+         "bench._mc_serve_impl(emulated=True)" % repo],
+        env=env, timeout=1500,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"mc_serve emulated child exited rc={r.returncode} "
+            "(its stdout/stderr are inherited above)"
+        )
+
+
+def _mc_serve_impl(emulated: bool) -> None:
+    from tpudist import memory
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.serve import ServeEngine
+    from tpudist.serve.engine import engine_param_shardings
+    from tpudist.serve.spec import cache_bytes
+
+    gb = 1024 ** 3
+    hbm = 16 * gb
+
+    # --- capacity: the does-not-fit demonstration (accounting only) ---
+    tp, slots_cap, block_cap = 4, 16, 32
+    cap = GPT2(vocab_size=50257, max_seq_len=2048, hidden_dim=4096,
+               depth=32, num_heads=32, dtype=jnp.bfloat16)
+    shapes = jax.eval_shape(lambda: cap.init(
+        jax.random.key(0), jnp.zeros((1, 1), jnp.int32), train=False
+    )["params"])
+    # serving resides bf16 (the decode legs' convention); init traces fp32
+    shapes = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(
+            l.shape,
+            jnp.bfloat16 if jnp.issubdtype(l.dtype, jnp.floating)
+            else l.dtype,
+        ),
+        shapes,
+    )
+    mesh_cap = mesh_lib.create_mesh(mesh_lib.MeshConfig(tensor=tp))
+    w_repl = memory.per_device_bytes(shapes)
+    w_shard = memory.per_device_bytes(
+        shapes, engine_param_shardings(cap, shapes, mesh_cap)
+    )
+    # pool bytes from the model's own cache tree: per-token KV bytes ×
+    # the pool's token capacity (n_blocks sized the paged leg's way —
+    # full worst case for every slot, the point being that even the
+    # UN-overcommitted pool fits once sharded)
+    n_blocks = slots_cap * (cap.max_seq_len // block_cap) + 1
+    pool_repl = (
+        cache_bytes(cap, 1) // cap.max_seq_len * n_blocks * block_cap
+    )
+    pool_shard = (
+        cache_bytes(cap, 1, tensor_world=tp) // cap.max_seq_len
+        * n_blocks * block_cap
+    )
+    repl, shard = w_repl + pool_repl, w_shard + pool_shard
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(shapes)
+    )
+    _record_line(
+        {
+            "metric": "gpt2_6b_mc_serve_hbm_budget",
+            "value": round(shard / gb, 2),
+            "unit": "GB/chip, GPT-2 4096x32 (%.2fB params) bf16 + a "
+            "%d-slot seq-%d paged pool (%d blocks), tensor-sharded over "
+            "tp=%d (weights by Megatron metadata — %0.2f GB/chip, vocab "
+            "table replicated where %d %% tp != 0; KV pool on the "
+            "KV-head dim — %0.2f GB/chip); REPLICATED, the same engine "
+            "is %.2f GB/chip (%s 16 GB) — the model is servable ONLY "
+            "sharded; eval_shape accounting, docs/SERVING.md §7 + PERF "
+            "§7e; vs_baseline = min(replicated/16GB, 16GB/sharded) — "
+            ">=1 iff it provably overflows one chip AND fits sharded" % (
+                n_params / 1e9, slots_cap, cap.max_seq_len, n_blocks, tp,
+                w_shard / gb, cap.vocab_size, pool_shard / gb,
+                repl / gb, "also under" if repl <= hbm else "provably over",
+            ),
+            "replicated_gb_per_chip": round(repl / gb, 2),
+            "weights_gb_sharded": round(w_shard / gb, 2),
+            "pool_gb_sharded": round(pool_shard / gb, 2),
+            "tensor_world": tp,
+            "vs_baseline": round(min(repl / hbm, hbm / shard), 4),
+        }
+    )
+
+    # --- tok/s A/B: tensor=2 vs single chip, equal model + traffic ---
+    if emulated:
+        model = GPT2(vocab_size=1024, max_seq_len=256, hidden_dim=256,
+                     depth=4, num_heads=8)
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 16), jnp.int32), train=False
+        )["params"]
+        slots, n_req, block, vmax = 4, 12, 16, 64.0
+    else:
+        model = GPT2(dtype=jnp.bfloat16, max_seq_len=1024)
+        params32 = jax.jit(
+            lambda: model.init(
+                jax.random.key(0), jnp.zeros((1, 16), jnp.int32),
+                train=False,
+            )["params"]
+        )()
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params32,
+        )
+        slots, n_req, block, vmax = 8, 32, 32, 448.0
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+    mesh2 = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(tensor=2), devices=jax.devices()[:2]
+    )
+    rng = np.random.Generator(np.random.PCG64(0))
+    plens = rng.integers(8, 65, n_req)
+    budgets = np.minimum(8 + rng.exponential(32.0, n_req), vmax).astype(
+        np.int32
+    )
+    prompts = [
+        rng.integers(0, model.vocab_size, (p,)).astype(np.int32)
+        for p in plens
+    ]
+    useful = int(budgets.sum())
+    arrivals = np.concatenate(
+        [[0.0], np.cumsum(rng.exponential(1.0, n_req - 1))]
+    )
+
+    def drive(engine, window: float):
+        arr = arrivals * (window / max(arrivals[-1], 1e-9))
+        t0 = time.perf_counter()
+        nxt = 0
+        while nxt < n_req or engine.pending:
+            now = time.perf_counter() - t0
+            while nxt < n_req and arr[nxt] <= now:
+                engine.submit(prompts[nxt], int(budgets[nxt]))
+                nxt += 1
+            if engine.pending:
+                engine.step()
+            elif nxt < n_req:
+                time.sleep(min(0.002, float(arr[nxt]) - now))
+        return time.perf_counter() - t0
+
+    n_blk = slots * (model.max_seq_len // block) + 1
+    kw = dict(max_slots=slots, paged=True, block_size=block, n_blocks=n_blk)
+    one_eng = ServeEngine(model, params, **kw)
+    mc_eng = ServeEngine(model, params, mesh=mesh2, **kw)
+
+    # warmup drain doubles as the §7 contract check: greedy output must
+    # be token-identical across topologies
+    streams = {}
+    for name, eng in (("one", one_eng), ("mc", mc_eng)):
+        rids = [
+            eng.submit(prompts[i], int(budgets[i])) for i in range(n_req)
+        ]
+        eng.run()
+        streams[name] = [eng.result(r) for r in rids]
+    assert streams["one"] == streams["mc"], (
+        "sharded greedy output diverged from single-chip"
+    )
+    one_eng.reset_stats()
+    window = 0.3 * drive(one_eng, 1e-9)
+    walls = {"one": [], "mc": []}
+    for _ in range(3):
+        for name, eng in (("one", one_eng), ("mc", mc_eng)):
+            eng.reset_stats()
+            wall = drive(eng, window)
+            snap = eng.stats.snapshot()
+            assert snap["tokens"] == useful, (name, snap["tokens"], useful)
+            walls[name].append(wall)
+    one_tps = useful / float(np.median(walls["one"]))
+    mc_tps = useful / float(np.median(walls["mc"]))
+    ratio = mc_tps / one_tps
+    label = (
+        "EMULATED 8-CPU world: functional proof — two virtual chips "
+        "share one host's bandwidth, ratio <1 expected off-TPU"
+        if emulated else "one v5e pair vs one chip"
+    )
+    _record_line(
+        {
+            "metric": "gpt2_mc_serve_tokens_per_sec",
+            "value": round(mc_tps, 2),
+            "unit": "useful tokens/sec, TENSOR-SHARDED paged engine "
+            f"(tensor=2, {label}): greedy, output token-identical to "
+            f"the single-chip engine (asserted); single-chip baseline "
+            f"{one_tps:.1f} tok/s, ratio {ratio:.2f}x; prompts 8-64, "
+            f"budgets 8+Exp(32)<={vmax:.0f}, Poisson arrivals over "
+            f"{window:.1f}s, interleaved medians of 3, compile "
+            "excluded; vs_baseline = ratio — the aggregated-HBM bar "
+            "(>=1, approaching 2x) applies on real ICI, docs/PERF.md "
+            "§7e",
+            "single_chip_tokens_per_sec": round(one_tps, 2),
+            "tps_ratio": round(ratio, 4),
+            "tensor_world": 2,
+            "emulated": emulated,
+            "vs_baseline": round(ratio, 4),
+        }
+    )
+
+
 def bench_memory_discipline() -> None:
     """The memory-discipline leg (docs/PERF.md §10): a ~1.1B-param GPT-2
     geometry (1536 wide × 36 layers, seq 1024, vocab 50257) budgeted
@@ -2737,6 +2981,11 @@ _LEG_GROUPS = {
     # composed-parallelism: eval_shape budgets + a live fsdp x tensor
     # train + the 1F1B-vs-GPipe A/B (emulated-child fallback off-TPU)
     "parallel3d": (bench_parallel3d, 1800),
+    # multi-chip serving: the capacity accounting (eval_shape only) +
+    # the tensor=2-vs-single-chip tok/s A/B — two paged engine
+    # inventories, a bit-identity warmup drain each, 3 interleaved timed
+    # runs per side (emulated-child fallback off-TPU)
+    "mc_serve": (bench_mc_serve, 1800),
 }
 
 

@@ -65,6 +65,11 @@ def parse_args(argv=None):
                    "(docs/SERVING.md §6)")
     p.add_argument("--spec_k", default=4, type=int,
                    help="with --spec_draft: proposals per slot per tick")
+    p.add_argument("--tensor", default=1, type=int,
+                   help="tensor-parallel world: shard the engine (weights "
+                   "by their Megatron metadata, KV pools on the KV-head "
+                   "dim) over the mesh's 'tensor' axis; num_heads must "
+                   "divide it (docs/SERVING.md §7). 1 = single chip")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--log_dir", default=".", type=str)
     p.add_argument("--JobID", default="Serve", type=str)
@@ -134,9 +139,19 @@ def main(argv=None):
         )
         spec_kw = dict(draft_model=draft_model, draft_params=draft_params,
                        spec_k=args.spec_k)
+    mesh_kw = {}
+    if args.tensor > 1:
+        from tpudist import mesh as mesh_lib
+
+        # the engine refuses loudly when num_heads (or a GQA model's KV
+        # heads) doesn't divide the tensor world — surface that before
+        # any weights move
+        mesh_kw = {"mesh": mesh_lib.create_mesh(
+            mesh_lib.MeshConfig(tensor=args.tensor)
+        )}
     engine = ServeEngine(
         model, params, max_slots=args.slots, max_queue=args.max_queue,
-        seed=args.seed, sink=sink, stats_every=10, **spec_kw,
+        seed=args.seed, sink=sink, stats_every=10, **spec_kw, **mesh_kw,
     )
     rids = [
         engine.submit(

@@ -233,6 +233,12 @@ def parse_args(argv=None):
                         help="with --spec_draft: draft tokens proposed per "
                         "slot per tick (a slot emits up to spec_k+1 "
                         "tokens per verified sweep)")
+    parser.add_argument("--tensor", default=1, type=int,
+                        help="with --serve: tensor-parallel world — the "
+                        "engine runs sharded over the mesh's 'tensor' "
+                        "axis (weights by their Megatron metadata, KV "
+                        "pools on the KV-head dim; docs/SERVING.md §7). "
+                        "num_heads must divide it; 1 = single chip")
     parser.add_argument("--no_profiler", action="store_true")
     parser.add_argument("--log_dir", default=".", type=str)
     parser.add_argument("--checkpoint_dir", default=None, type=str,
@@ -311,9 +317,18 @@ def _serve_demo(args):
         )
         spec_kw = dict(draft_model=draft_model, draft_params=draft_params,
                        spec_k=args.spec_k)
+    mesh_kw = {}
+    if args.tensor > 1:
+        from tpudist import mesh as mesh_lib
+
+        # head-divisibility is validated by the engine with a loud
+        # ValueError before any weights move
+        mesh_kw = {"mesh": mesh_lib.create_mesh(
+            mesh_lib.MeshConfig(tensor=args.tensor)
+        )}
     engine = ServeEngine(model, params, max_slots=args.serve_slots,
                          sink=sink, stats_every=10, on_token=on_token,
-                         **spec_kw)
+                         **spec_kw, **mesh_kw)
     rng = np.random.Generator(np.random.PCG64(0))
     for i in range(args.serve_requests):
         engine.submit(

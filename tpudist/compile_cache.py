@@ -38,6 +38,16 @@ one-shot ``compile_cache`` telemetry row records hit/miss/bytes/load_s
 (docs/OBSERVABILITY.md); ``tpudist.resilience.goodput`` attributes a warm
 first iteration to ``cache_load_s`` instead of mislabeling it
 ``compile_s``.
+
+The serving engine reuses this store for its program inventory
+(``ServeEngine(compile_cache=dir)``, docs/SERVING.md §5) with its own
+fingerprint discipline: the engine's key covers the model identity,
+params geometry, every scheduler knob — and, on a tensor-sharded engine
+(``mesh=``, docs/SERVING.md §7), the mesh axis names/shape and the
+tensor world, for the same reason ``step_key`` hashes the topology: an
+executable lowered with committed ``NamedSharding`` arguments is
+placement-specific, and a single-chip artifact must never warm-start a
+sharded engine (or vice versa, or across different tensor worlds).
 """
 
 from __future__ import annotations

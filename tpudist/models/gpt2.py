@@ -106,6 +106,7 @@ class Block(nn.Module):
                 attn = paged_decode_attention(
                     q, keys, values, mask, pos,
                     impl="xla" if self.attn_impl == "xla" else "paged",
+                    mesh=self.mesh,
                 )
             else:
                 # one fused Pallas launch per layer per token unless the

@@ -151,6 +151,7 @@ class LlamaBlock(nn.Module):
                 attn = paged_decode_attention(
                     q, keys, values, mask, pos,
                     impl="xla" if self.attn_impl == "xla" else "paged",
+                    mesh=self.mesh,
                 )
             else:
                 # fused path reads grouped K/V heads natively (no repeat in

@@ -476,7 +476,7 @@ def _paged_fused_attention(q, k_pool, v_pool, block_tables, positions):
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
-                           impl: str = "paged"):
+                           impl: str = "paged", mesh=None):
     """Attention over the PAGED pool from :func:`cached_kv`'s block-table
     mode (``q [B, s, H, dh]`` activation layout, pools head-major
     ``[n_blocks, H_kv, block_size, dh]``). ``s == 1`` is the sampling
@@ -493,7 +493,18 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
     which is what converts the paged layout's saved bytes into tok/s
     (docs/PERF.md §7c measures the A/B). ``impl="xla"`` is the
     gather-then-dense oracle the kernel is tested against (and the
-    correctness path on models pinned to ``attn_impl="xla"``)."""
+    correctness path on models pinned to ``attn_impl="xla"``).
+
+    ``mesh``: pass the serving mesh on a multi-chip tensor-sharded engine
+    (``tpudist.serve.engine.ServeEngine(mesh=...)``). ``pallas_call`` has
+    no GSPMD partitioning rule, so on a >1-device ``tensor`` axis the
+    kernel runs per-shard inside ``shard_map``: q splits on its head dim,
+    the pools on their KV-head dim (the engine shards the block pool
+    ``[n_blocks, H_kv/T, block_size, dh]`` per chip), block tables and
+    positions stay replicated. Softmax is complete per head, so the wrap
+    is exact with no collective — each chip walks the SAME block tables
+    over its own head slice of the pool. The dense oracle path needs no
+    wrap (gather + einsums partition under plain GSPMD)."""
     paged_ok = (
         q.shape[2] % k_pool.shape[1] == 0
         # one block's K+V panel stays far under VMEM at any sane
@@ -501,6 +512,35 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
         # unit is a block, not a row's full window)
     )
     if impl == "paged" and paged_ok:
+        if mesh is not None:
+            from tpudist import mesh as mesh_lib
+
+            tp = int(mesh.shape[mesh_lib.TENSOR_AXIS]) \
+                if mesh_lib.TENSOR_AXIS in mesh.axis_names else 1
+            h, h_kv = q.shape[2], k_pool.shape[1]
+            if tp > 1 and h % tp == 0 and h_kv % tp == 0:
+                from jax.sharding import PartitionSpec as P
+
+                from tpudist.utils.compat import shard_map
+
+                fn = shard_map(
+                    _paged_fused_attention,
+                    mesh=mesh,
+                    in_specs=(
+                        P(None, None, mesh_lib.TENSOR_AXIS, None),  # q heads
+                        P(None, mesh_lib.TENSOR_AXIS, None, None),  # k pool
+                        P(None, mesh_lib.TENSOR_AXIS, None, None),  # v pool
+                        P(None, None),  # block tables: replicated
+                        P(None),        # positions: replicated
+                    ),
+                    out_specs=P(None, None, mesh_lib.TENSOR_AXIS, None),
+                    # pallas_call can't declare varying-manual-axes on its
+                    # out_shape (same caveat as ops/attention.py's wrap)
+                    check_vma=False,
+                )
+                return fn(q, k_pool, v_pool,
+                          jnp.asarray(block_tables, jnp.int32),
+                          jnp.asarray(positions, jnp.int32))
         return _paged_fused_attention(q, k_pool, v_pool, block_tables,
                                       positions)
     # dense oracle: gather each row's table into a contiguous window and

@@ -63,14 +63,21 @@ import numpy as np
 GARBAGE_BLOCK = 0
 
 
-def paged_cache(model, n_blocks: int, block_size: int):
+def paged_cache(model, n_blocks: int, block_size: int, kv_sharding=None):
     """The device-side block pool: the model's contiguous decode-cache
     tree (``init_cache`` shapes at batch 1) with every 4-D
     ``[1, H, max_len, dh]`` K/V leaf re-shaped to
     ``[n_blocks, H, block_size, dh]``. Scalar cursor leaves keep their
     (unused in paged mode, but structure-preserving) zeros — the same
     tree-structure discipline that lets one donated pytree flow through
-    the compiled decode step."""
+    the compiled decode step.
+
+    ``kv_sharding``: optional :class:`jax.sharding.NamedSharding` for the
+    4-D pool leaves — the multi-chip engine shards the pool on the
+    KV-head dim (``[n_blocks, H_kv/T, block_size, dh]`` per chip,
+    ``P(None, 'tensor', None, None)``); scalar leaves stay replicated.
+    Host block tables are NOT affected — all chips see the same logical
+    pool, each holding its own head slice of every block."""
     shapes = jax.eval_shape(
         lambda: model.init(
             jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
@@ -78,13 +85,26 @@ def paged_cache(model, n_blocks: int, block_size: int):
         )
     )["cache"]
 
+    rep = None
+    if kv_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(kv_sharding.mesh, PartitionSpec())
+
     def build(leaf):
         if len(leaf.shape) == 4:
-            return jnp.zeros(
+            buf = jnp.zeros(
                 (n_blocks, leaf.shape[1], block_size, leaf.shape[3]),
                 leaf.dtype,
             )
-        return jnp.zeros(leaf.shape, leaf.dtype)
+            return buf if kv_sharding is None else jax.device_put(
+                buf, kv_sharding
+            )
+        buf = jnp.zeros(leaf.shape, leaf.dtype)
+        # scalar cursors commit replicated on the same mesh — a leaf left
+        # on one device would make the decode step's AOT lowering mix
+        # device sets
+        return buf if rep is None else jax.device_put(buf, rep)
 
     return jax.tree_util.tree_map(build, shapes)
 
@@ -327,7 +347,8 @@ class PagedSlotPool:
     """
 
     def __init__(self, model, max_slots: int, *, n_blocks: int,
-                 block_size: int, prefix_cache: bool = True):
+                 block_size: int, prefix_cache: bool = True,
+                 kv_sharding=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if not hasattr(model, "init_cache"):
@@ -349,7 +370,7 @@ class PagedSlotPool:
         self.prefix = (
             PrefixCache(self.blocks, block_size) if prefix_cache else None
         )
-        self.cache = paged_cache(model, n_blocks, block_size)
+        self.cache = paged_cache(model, n_blocks, block_size, kv_sharding)
         self.tables = np.zeros((max_slots, self.max_blocks), np.int32)
         self.fill = np.zeros(max_slots, np.int32)  # table entries in use
         self.positions = np.zeros(max_slots, np.int32)
@@ -372,7 +393,16 @@ class PagedSlotPool:
     def utilization(self) -> float:
         """BLOCK occupancy (the byte truth), NOT active/max_slots — see
         the class docstring for why the slot-count reading is wrong under
-        paged admission."""
+        paged admission.
+
+        On a tensor-sharded engine (``ServeEngine(mesh=...)``) this is a
+        PER-CHIP reading: the pool shards on the KV-head dim, so every
+        chip maps the same block set (one host-side ``BlockPool``, one
+        table) and occupancy is identical on all T chips — the fraction
+        reported here is of each chip's ``n_blocks × bytes/T`` slice, not
+        of the aggregate. The ``serve`` rows label it with
+        ``tensor_world`` so readers can do the aggregate math
+        (docs/OBSERVABILITY.md §1)."""
         return self.blocks.occupancy
 
     def blocks_for(self, n_tokens: int) -> int:

@@ -387,6 +387,71 @@ def _build_decode_step(model, params, base_key, paged: bool):
     return step
 
 
+def engine_param_shardings(model, params, mesh):
+    """``NamedSharding`` tree for a serving param tree over ``mesh``, by
+    the models' own Megatron ``nn.with_partitioning`` metadata (the same
+    annotations the training side shards by —
+    ``tpudist.train.state_shardings_from_meta``; unannotated leaves
+    replicate). One deviation from the training path: a spec dim whose
+    size the mesh axis does NOT divide is dropped to replicated for that
+    dim — jax refuses uneven named placements at runtime (tpudist.memory's
+    ceil-shard note), and GPT-2's 50257-row vocab table under ``tensor=2``
+    is exactly that case. Replicating such a leaf is always correct under
+    GSPMD (the matmuls still partition on the other operand); it just
+    forgoes that leaf's byte saving.
+
+    ``params`` may be concrete arrays or a ``jax.eval_shape`` tree — only
+    leaf SHAPES are read, so the ``mc_serve`` bench leg budgets a
+    geometry's per-chip bytes (``tpudist.memory.per_device_bytes``)
+    without materializing a weight."""
+    import flax.linen as nn
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    specs = nn.get_partition_spec(jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, 1), jnp.int32), train=False
+        )["params"]
+    ))
+    # PartitionSpec is a tuple subclass: flatten with is_leaf, and align
+    # leaves by flatten order (dict/FrozenDict both flatten key-sorted) so
+    # the spec tree's container types need not match the params tree's
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, P)
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if len(spec_leaves) != len(leaves):
+        raise ValueError(
+            f"params tree has {len(leaves)} leaves but the model's "
+            f"partition-spec tree has {len(spec_leaves)} — params do not "
+            "belong to this model architecture"
+        )
+
+    def fix(spec, leaf):
+        dims = []
+        for i, ax in enumerate(spec):
+            if ax is None:
+                dims.append(None)
+                continue
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            world = int(np.prod([mesh.shape[a] for a in axes]))
+            dims.append(ax if leaf.shape[i] % world == 0 else None)
+        return P(*dims)
+
+    shardings = [
+        NamedSharding(mesh, fix(spec, leaf))
+        for spec, leaf in zip(spec_leaves, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def _shard_engine_params(model, params, mesh):
+    """Place a serving param tree over ``mesh`` per
+    :func:`engine_param_shardings`."""
+    shardings = engine_param_shardings(model, params, mesh)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
 @jax.jit
 def _first_token(logits, base_key, request_id, temperature, top_k, top_p):
     """Sample a just-prefilled request's first token (token index 0 of its
@@ -453,7 +518,61 @@ class ServeEngine:
                  n_blocks: int | None = None, prefix_cache: bool = True,
                  watermark_blocks: int | None = None,
                  ttft_slo_s: float | None = None, compile_cache=None,
-                 draft_model=None, draft_params=None, spec_k: int = 4):
+                 draft_model=None, draft_params=None, spec_k: int = 4,
+                 mesh=None):
+        self.mesh = mesh
+        self.tensor_world = 1
+        self._kv_sharding = None
+        self._rep_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from tpudist.mesh import TENSOR_AXIS
+
+            if TENSOR_AXIS in mesh.axis_names:
+                self.tensor_world = int(mesh.shape[TENSOR_AXIS])
+            self._rep_sharding = NamedSharding(mesh, P())
+        if self.tensor_world > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from tpudist.mesh import TENSOR_AXIS
+
+            for name, m in (("model", model), ("draft_model", draft_model)):
+                if m is None:
+                    continue
+                h = int(m.num_heads)
+                h_kv = int(getattr(m, "num_kv_heads", None) or h)
+                if h % self.tensor_world or h_kv % self.tensor_world:
+                    raise ValueError(
+                        f"{name}: num_heads={h} / num_kv_heads={h_kv} not "
+                        f"divisible by tensor={self.tensor_world} — the KV "
+                        "pool shards on the KV-head dim and the paged "
+                        "kernel runs per-shard, so BOTH head counts must "
+                        "divide the tensor world (GQA: the KV heads are "
+                        "the binding constraint); pick a smaller tensor= "
+                        "or serve unsharded (mesh=None)"
+                    )
+            # the models already thread mesh= (context-parallel attention
+            # uses the same field); setting it here routes the paged
+            # kernel through its shard_map wrap (ops/decode.py)
+            if getattr(model, "mesh", None) is not mesh:
+                model = model.clone(mesh=mesh)
+            params = _shard_engine_params(model, params, mesh)
+            if draft_model is not None and draft_params is not None:
+                if getattr(draft_model, "mesh", None) is not mesh:
+                    draft_model = draft_model.clone(mesh=mesh)
+                draft_params = _shard_engine_params(
+                    draft_model, draft_params, mesh
+                )
+            # the KV pools — contiguous [S, H_kv, max_len, dh], paged
+            # [n_blocks, H_kv, block_size, dh], and the prefiller's
+            # batch-1 rows — all shard on their KV-head dim (dim 1);
+            # host-side tables/cursors stay replicated
+            self._kv_sharding = NamedSharding(
+                mesh, P(None, TENSOR_AXIS, None, None)
+            )
         self.model = model
         self.params = params
         self.spec = draft_model is not None
@@ -494,20 +613,25 @@ class ServeEngine:
                 n_blocks = max_slots * (model.max_seq_len // block_size) + 1
             self.pool = PagedSlotPool(
                 model, max_slots, n_blocks=n_blocks, block_size=block_size,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, kv_sharding=self._kv_sharding,
             )
             self.watermark = (
                 max_slots if watermark_blocks is None else int(watermark_blocks)
             )
         else:
-            self.pool = SlotPool(model, max_slots)
+            self.pool = SlotPool(
+                model, max_slots, kv_sharding=self._kv_sharding
+            )
             self.watermark = 0
-        self.prefiller = Prefiller(model, params, chunk=prefill_chunk)
+        self.prefiller = Prefiller(
+            model, params, chunk=prefill_chunk,
+            kv_sharding=self._kv_sharding,
+        )
         self.on_token = on_token
         self.ttft_slo_s = ttft_slo_s
         self.stats = ServeStats(
             slots=max_slots, sink=sink, every=stats_every, clock=clock,
-            paged=self.paged,
+            paged=self.paged, tensor_world=self.tensor_world,
         )
         self._base_key = jax.random.key(seed)
         if self.spec:
@@ -518,9 +642,12 @@ class ServeEngine:
             # draft prefiller: the draft's first proposal conditions on
             # the target-sampled first token, so its prompt-end logits
             # are never read
-            self._draft_pool = SlotPool(draft_model, max_slots)
+            self._draft_pool = SlotPool(
+                draft_model, max_slots, kv_sharding=self._kv_sharding
+            )
             self._draft_prefiller = Prefiller(
                 draft_model, draft_params, chunk=prefill_chunk, head=False,
+                kv_sharding=self._kv_sharding,
             )
             self._decode_fn = _build_spec_step(
                 model, params, draft_model, draft_params, self._base_key,
@@ -555,12 +682,12 @@ class ServeEngine:
         # the device-carried token feedback (each step's samples feed the
         # next step without a host round-trip) and the admission overrides
         # that splice a new request's first token into its slot's lane
-        self._prev_tok = jnp.zeros(s, jnp.int32)
+        self._prev_tok = self._dev(jnp.zeros(s, jnp.int32))
         self._override: dict[int, int] = {}
         # speculative device-carried cursor lane + per-slot emission limit
         # (prompt_len + max_new — the spec step's one clamp covering both
         # sequence end and budget); host positions sync at each fetch
-        self._pos_dev = jnp.zeros(s, jnp.int32)
+        self._pos_dev = self._dev(jnp.zeros(s, jnp.int32))
         self._limit = np.zeros(s, np.int32)
         self._inflight: _Inflight | None = None
         self._drained_events: list[TokenEvent] = []
@@ -689,9 +816,23 @@ class ServeEngine:
         self.stats = ServeStats(
             slots=self.pool.max_slots, sink=s.sink, every=s.every,
             clock=s._clock, paged=self.paged,
+            tensor_world=self.tensor_world,
         )
 
     # -- internals ---------------------------------------------------------
+
+    def _dev(self, x):
+        """Host lane → device argument. On a mesh engine the lane commits
+        to the REPLICATED placement: the compiled step's weights and KV
+        live mesh-sharded, and the AOT executables validate argument
+        shardings, so an uncommitted single-device array would either
+        force a reshard per tick or fail warm-start validation outright.
+        Off-mesh this is a plain ``jnp.asarray`` (the call sites keep
+        their ``.copy()`` snapshots — the XLA:CPU aliasing discipline is
+        unchanged)."""
+        if self._rep_sharding is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._rep_sharding)
 
     def _emit(self, rid: int, token: int, done: bool) -> TokenEvent:
         ev = TokenEvent(rid, token, self._counts[rid], done)
@@ -1014,16 +1155,16 @@ class ServeEngine:
         # before becoming a device argument (XLA:CPU zero-copy aliasing)
         args = [
             self.pool.cache, self._draft_pool.cache, self._prev_tok,
-            jnp.asarray(override_tok), jnp.asarray(use_override),
-            self._pos_dev, jnp.asarray(override_pos),
+            self._dev(override_tok), self._dev(use_override),
+            self._pos_dev, self._dev(override_pos),
         ]
         if self.paged:
-            args.append(jnp.asarray(self.pool.tables.copy()))
+            args.append(self._dev(self.pool.tables.copy()))
         args += [
-            jnp.asarray(~live), jnp.asarray(self._req.astype(np.int32)),
-            jnp.asarray(self._temp.copy()), jnp.asarray(self._topk.copy()),
-            jnp.asarray(self._topp.copy()), jnp.asarray(self._eos.copy()),
-            jnp.asarray(self._limit.copy()),
+            self._dev(~live), self._dev(self._req.astype(np.int32)),
+            self._dev(self._temp.copy()), self._dev(self._topk.copy()),
+            self._dev(self._topp.copy()), self._dev(self._eos.copy()),
+            self._dev(self._limit.copy()),
         ]
         (self.pool.cache, self._draft_pool.cache, new_pos, next_tok, emit,
          n_emit, n_spec, done_dev) = self._call_decode(*args)
@@ -1107,16 +1248,16 @@ class ServeEngine:
         # test. The copies are tiny ([S]-scalar lanes and the [S, MB]
         # table) next to the decode step itself.
         args = [
-            self.pool.cache, self._prev_tok, jnp.asarray(override_tok),
-            jnp.asarray(use_override), jnp.asarray(self.pool.positions.copy()),
+            self.pool.cache, self._prev_tok, self._dev(override_tok),
+            self._dev(use_override), self._dev(self.pool.positions.copy()),
         ]
         if self.paged:
-            args.append(jnp.asarray(self.pool.tables.copy()))
+            args.append(self._dev(self.pool.tables.copy()))
         args += [
-            jnp.asarray(~live), jnp.asarray(self._req.astype(np.int32)),
-            jnp.asarray(self._dispatched.copy()), jnp.asarray(self._temp.copy()),
-            jnp.asarray(self._topk.copy()), jnp.asarray(self._topp.copy()),
-            jnp.asarray(self._eos.copy()),
+            self._dev(~live), self._dev(self._req.astype(np.int32)),
+            self._dev(self._dispatched.copy()), self._dev(self._temp.copy()),
+            self._dev(self._topk.copy()), self._dev(self._topp.copy()),
+            self._dev(self._eos.copy()),
         ]
         self.pool.cache, tok_dev, done_dev = self._call_decode(*args)
         self._prev_tok = tok_dev
@@ -1205,6 +1346,17 @@ class ServeEngine:
             # draft architecture, and closes over the draft weights too
             "spec_k": self.spec_k if self.spec else 0,
             "draft": model_identity(self.draft_model) if self.spec else None,
+            # mesh topology: the executables bake in the device assignment
+            # and every argument's sharding — a cache dir shared across
+            # topologies must miss cheaply here, not fail (or worse,
+            # validate) a wrong-geometry executable at first call
+            "mesh": None if self.mesh is None else {
+                "axes": [str(a) for a in self.mesh.axis_names],
+                "shape": [
+                    int(self.mesh.shape[a]) for a in self.mesh.axis_names
+                ],
+            },
+            "tensor_world": self.tensor_world,
         }
         h.update(json.dumps(cfg, sort_keys=True).encode())
         trees = [("", self.params)]
@@ -1233,6 +1385,12 @@ class ServeEngine:
         info: dict = {"hits": 0, "misses": 0, "programs": {}, "bytes": 0}
 
         def sds(x):
+            # mesh engine: the lowered executable must see each argument's
+            # COMMITTED sharding (replicated lanes, KV-sharded pools) or
+            # first-call validation rejects the real args
+            sh = getattr(x, "sharding", None)
+            if self.mesh is not None and sh is not None:
+                return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sh)
             return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
 
         def fetch(name, jitted, *example):
@@ -1271,36 +1429,54 @@ class ServeEngine:
 
         s = self.pool.max_slots
         cache_ex = self.pool.cache
-        i32 = lambda *shape: jnp.zeros(shape, jnp.int32)
+        # mesh engine: every example lane commits replicated (same _dev
+        # discipline the per-tick dispatch uses), so the lowered argument
+        # shardings match what the engine will actually pass
+        i32 = lambda *shape: self._dev(jnp.zeros(shape, jnp.int32))
+        zeros_b = lambda: self._dev(jnp.zeros(s, bool))
+        zeros_f = lambda: self._dev(jnp.zeros(s, jnp.float32))
+        ones_f = lambda: self._dev(jnp.ones(s, jnp.float32))
         if self.spec:
             decode_args = [
                 cache_ex, self._draft_pool.cache, i32(s), i32(s),
-                jnp.zeros(s, bool), i32(s), i32(s),
+                zeros_b(), i32(s), i32(s),
             ]
             if self.paged:
                 decode_args.append(i32(s, self.pool.max_blocks))
             decode_args += [
-                jnp.zeros(s, bool), i32(s), jnp.zeros(s, jnp.float32),
-                i32(s), jnp.ones(s, jnp.float32), i32(s), i32(s),
+                zeros_b(), i32(s), zeros_f(),
+                i32(s), ones_f(), i32(s), i32(s),
             ]
             self._decode_aot = {"exe": fetch("spec", self._decode_fn,
                                              *decode_args)}
         else:
             decode_args = [
-                cache_ex, i32(s), i32(s), jnp.zeros(s, bool), i32(s),
+                cache_ex, i32(s), i32(s), zeros_b(), i32(s),
             ]
             if self.paged:
                 decode_args.append(i32(s, self.pool.max_blocks))
             decode_args += [
-                jnp.zeros(s, bool), i32(s), i32(s), jnp.zeros(s, jnp.float32),
-                i32(s), jnp.ones(s, jnp.float32), i32(s),
+                zeros_b(), i32(s), i32(s), zeros_f(),
+                i32(s), ones_f(), i32(s),
             ]
             self._decode_aot = {"exe": fetch("decode", self._decode_fn,
                                              *decode_args)}
         # _cache_shapes is already a ShapeDtypeStruct tree and sds() maps
         # it through unchanged — no device-side batch-1 cache allocation
-        # just to describe shapes
+        # just to describe shapes (mesh engine: re-struct with the KV
+        # sharding the prefiller's fresh caches actually carry)
         row_ex = self.prefiller._cache_shapes
+        if self._kv_sharding is not None:
+            row_ex = jax.tree_util.tree_map(
+                lambda t: jax.ShapeDtypeStruct(
+                    t.shape, t.dtype,
+                    sharding=(
+                        self._kv_sharding if len(t.shape) == 4
+                        else self._rep_sharding
+                    ),
+                ),
+                row_ex,
+            )
         buckets, b = [], self.prefiller.minimum
         while b <= self.prefiller.chunk:
             buckets.append(b)
@@ -1324,6 +1500,17 @@ class ServeEngine:
             # needs a body executable at every bucket, not just `chunk`
             dpf = self._draft_prefiller
             d_row_ex = dpf._cache_shapes
+            if self._kv_sharding is not None:
+                d_row_ex = jax.tree_util.tree_map(
+                    lambda t: jax.ShapeDtypeStruct(
+                        t.shape, t.dtype,
+                        sharding=(
+                            self._kv_sharding if len(t.shape) == 4
+                            else self._rep_sharding
+                        ),
+                    ),
+                    d_row_ex,
+                )
             d_aot = {}
             for b in {*buckets, dpf.chunk}:
                 exe = fetch(f"dpb{b}", dpf._chunk_body, d_row_ex, i32(1, b))

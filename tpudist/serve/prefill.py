@@ -77,11 +77,16 @@ class Prefiller:
     chunk."""
 
     def __init__(self, model, params, *, chunk: int = 512, minimum: int = 8,
-                 head: bool = True):
+                 head: bool = True, kv_sharding=None):
         self.model = model
         self.chunk = min(int(chunk), model.max_seq_len)
         self.minimum = minimum
         self.head = head
+        # multi-chip engine (ServeEngine(mesh=...)): the fresh batch-1
+        # cache's [1, H_kv, max_len, dh] buffers start head-sharded so the
+        # chunk programs (which close over tensor-sharded params) see
+        # consistent placements instead of re-deciding them per admission
+        self.kv_sharding = kv_sharding
         if self.chunk < 1:
             raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
         self._cache_shapes = jax.eval_shape(
@@ -168,6 +173,18 @@ class Prefiller:
         cache = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
         )
+        if self.kv_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.kv_sharding.mesh, PartitionSpec())
+            cache = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(
+                    leaf,
+                    self.kv_sharding if getattr(leaf, "ndim", 0) == 4
+                    else rep,
+                ),
+                cache,
+            )
         return self.resume(cache, prompt, 0)
 
     def resume(self, cache, prompt, start: int):

@@ -57,7 +57,7 @@ class SlotPool:
     live device pytree the engine's compiled decode step donates through;
     ``positions``/``active`` are the per-slot masks it feeds in."""
 
-    def __init__(self, model, max_slots: int):
+    def __init__(self, model, max_slots: int, *, kv_sharding=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if not hasattr(model, "init_cache"):
@@ -68,6 +68,23 @@ class SlotPool:
         self.max_slots = max_slots
         self.max_seq_len = model.max_seq_len
         self.cache = model.init_cache(max_slots)
+        if kv_sharding is not None:
+            # multi-chip engine: the [max_slots, H_kv, max_len, dh] buffers
+            # shard on the head dim (P(None, 'tensor', None, None)); scalar
+            # cursors commit replicated on the SAME mesh (a leaf left on
+            # one device would make the AOT decode step's lowering mix
+            # device sets). Committing placements here keeps GSPMD from
+            # re-deciding the cache layout per decode step.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(kv_sharding.mesh, PartitionSpec())
+            self.cache = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(
+                    leaf,
+                    kv_sharding if getattr(leaf, "ndim", 0) == 4 else rep,
+                ),
+                self.cache,
+            )
         self.positions = np.zeros(max_slots, np.int32)
         self.active = np.zeros(max_slots, bool)
         # FIFO recycle order: deterministic slot assignment, and a retired
@@ -94,7 +111,10 @@ class SlotPool:
         property to report BLOCK-pool occupancy instead (a slot-count
         reading there overstates free capacity — the `serve` rows keep
         `slot_utilization` with the slot-count meaning and carry
-        `pool_occupancy` separately; docs/OBSERVABILITY.md §1)."""
+        `pool_occupancy` separately; docs/OBSERVABILITY.md §1). Slot
+        occupancy is topology-free: on a tensor-sharded engine the count
+        is the same on every chip, so unlike the paged pool's per-chip
+        byte reading this fraction needs no ``tensor_world`` footnote."""
         return self.n_active / self.max_slots
 
     def write_row(self, row_cache, slot: int) -> None:

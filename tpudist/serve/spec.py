@@ -188,16 +188,23 @@ def early_exit_draft(model, params, depth: int):
     return draft, dp
 
 
-def cache_bytes(model, rows: int) -> int:
+def cache_bytes(model, rows: int, *, tensor_world: int = 1) -> int:
     """KV-cache bytes ``model.init_cache(rows)`` would allocate (4-D K/V
     buffers only, via ``eval_shape`` — nothing materializes). The number
     the equal-HBM A/B and SERVING.md's "cache sizing with a draft" use:
     a speculative engine pays this for its draft on TOP of the target
     pool, so at fixed HBM the draft cache comes out of the target's block
-    budget (:func:`tpudist.serve.blocks.draft_equivalent_blocks`)."""
+    budget (:func:`tpudist.serve.blocks.draft_equivalent_blocks`).
+
+    ``tensor_world``: PER-CHIP bytes on a tensor-sharded engine
+    (``ServeEngine(mesh=...)``) — the 4-D buffers shard exactly on the
+    KV-head dim, so each chip holds ``1/T`` of every buffer (the engine's
+    head-divisibility refusal guarantees the split is even; the
+    ``mc_serve`` bench leg budgets with this)."""
     tree = jax.eval_shape(lambda: model.init_cache(rows))
-    return sum(
+    total = sum(
         int(np.prod(leaf.shape)) * leaf.dtype.itemsize
         for leaf in jax.tree_util.tree_leaves(tree)
         if len(leaf.shape) == 4
     )
+    return total // max(int(tensor_world), 1)

@@ -52,12 +52,18 @@ class ServeStats:
     and the notebook path read :meth:`snapshot` directly)."""
 
     def __init__(self, *, slots: int, sink=None, every: int = 50,
-                 clock=time.perf_counter, paged: bool = False):
+                 clock=time.perf_counter, paged: bool = False,
+                 tensor_world: int = 1):
         self.slots = slots
         self.sink = sink
         self.every = max(int(every), 0)
         self._clock = clock
         self.paged = paged
+        # tensor-parallel world of the engine (1 = single chip): rides
+        # every serve row so per-chip readings (pool_occupancy on a
+        # sharded block pool is of each chip's 1/T byte slice) carry
+        # their denominator — docs/OBSERVABILITY.md §1
+        self.tensor_world = int(tensor_world)
         self.t_start = clock()
         self.submitted = 0
         self.completed = 0
@@ -181,6 +187,7 @@ class ServeStats:
             "queue_depth": queue_depth,
             "active": active,
             "slots": self.slots,
+            "tensor_world": self.tensor_world,
             "slot_utilization": (
                 round(self._win_active / (self.slots * self._win_steps), 4)
                 if self._win_steps else 0.0
@@ -219,6 +226,7 @@ class ServeStats:
         wall = max(self._clock() - self.t_start, 1e-9)
         return {
             "wall_s": round(wall, 6),
+            "tensor_world": self.tensor_world,
             "tokens": self.tokens,
             "tokens_per_sec": round(self.tokens / wall, 2),
             "submitted": self.submitted,
