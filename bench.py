@@ -957,6 +957,119 @@ def bench_families() -> None:
           "32/chip, vmem attention, chunked MLM head")
 
 
+def bench_moe() -> None:
+    """Sparse GPT-2 (tpudist.parallel.ep): routed top-2 mixture-of-experts
+    train step, three timed sides at one geometry —
+
+    - dense GPT-2 124M (the iso-comparison trunk),
+    - MoE with ``dispatch_impl="einsum"`` (the one-hot oracle: O(t·E·C)
+      dispatch/combine einsums),
+    - MoE with ``dispatch_impl="index"`` (the headline path: slot-index
+      gather/scatter, O(t·k) bookkeeping + exactly top_k·t·d moved bytes).
+
+    The headline record is the index side's tokens/s. ``vs_dense`` is the
+    iso-active-FLOP comparison: each side's achieved model-FLOP throughput
+    (tokens/s x active FLOPs/token, telemetry.flops counters — the MoE side
+    uses the active-param "gpt2_moe" accounting), ratioed against the dense
+    trunk's. >= 1 means the sparse step turns hardware FLOPs into active
+    model FLOPs at least as well as the dense step — routing, dispatch and
+    the capacity padding cost nothing net. ``drop_rate`` is the measured
+    router drop fraction at capacity_factor 1.25 on the timed data (sowed
+    ``moe_stats``, docs/OBSERVABILITY.md §1). vs_baseline = the index
+    side's MFU, same convention as the families leg."""
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.telemetry import flops as tflops
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    seq, vocab, d, depth = 1024, 50257, 768, 12
+    n_experts, top_k, moe_every, cf = 8, 2, 2, 1.25
+    seqs = 8 * n_chips  # grad_accum=1: capacity is set by real tokens/step
+    tokens_per_step = seqs * seq
+    n_steps = 20
+    rng = np.random.Generator(np.random.PCG64(0))
+    tx = optax.adam(1e-3)
+
+    def timed_side(model):
+        state = create_train_state(
+            model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens",
+        )
+        batches = iter([
+            {"tokens": rng.integers(0, vocab, (seqs, seq)).astype(np.int32)}
+            for _ in range(n_steps + 3)
+        ])
+        for _ in range(3):
+            state, metrics = step(state, next(batches))
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, next(batches))
+        float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / n_steps
+        return state, tokens_per_step / dt, dt
+
+    common = dict(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
+    _, dense_tok_s, _ = timed_side(GPT2(**common))
+    moe_kw = dict(num_experts=n_experts, moe_every=moe_every,
+                  moe_top_k=top_k, capacity_factor=cf, **common)
+    _, einsum_tok_s, _ = timed_side(GPT2(moe_dispatch="einsum", **moe_kw))
+    moe_model = GPT2(moe_dispatch="index", **moe_kw)
+    moe_state, index_tok_s, index_dt = timed_side(moe_model)
+
+    # measured drop rate: one forward with the sowed moe_stats collection
+    # mutable (the telemetry=True path's source), averaged over MoE layers
+    probe = {"tokens": jnp.asarray(
+        rng.integers(0, vocab, (seqs, seq)).astype(np.int32))}
+    _, sown = moe_model.apply(
+        {"params": moe_state.params}, probe["tokens"], train=True,
+        mutable=["losses", "moe_stats"],
+    )
+    drops = [
+        float(leaf) for path, leaf in
+        jax.tree_util.tree_flatten_with_path(sown["moe_stats"])[0]
+        if any(getattr(p, "key", None) == "dropped" for p in path)
+    ]
+    drop_rate = sum(drops) / max(len(drops), 1)
+
+    t = tokens_per_step / n_chips  # per-chip accounting, like families
+    moe_flops = tflops.gpt2_moe_train_flops(
+        t, hidden=d, depth=depth, vocab=vocab, seq=seq,
+        num_experts=n_experts, moe_every=moe_every, top_k=top_k,
+    )
+    dense_flops = tflops.gpt2_train_flops(
+        t, hidden=d, depth=depth, vocab=vocab, seq=seq,
+    )
+    index_mfu = tflops.mfu(moe_flops, index_dt, peak=V5E_BF16_PEAK)
+    vs_dense = (index_tok_s * moe_flops) / (dense_tok_s * dense_flops)
+    _record_line(
+        {
+            "metric": "gpt2_moe_tokens_per_sec",
+            "value": round(index_tok_s, 2),
+            "unit": "tokens/sec, GPT-2 124M-geometry MoE (8 experts, "
+            "top-2, capacity 1.25, MoE every 2nd block, index dispatch; "
+            "bf16, seq 1024, batch 8/chip, vmem attention); vs_dense = "
+            "active-FLOP throughput vs the dense 124M trunk "
+            f"({round(dense_tok_s, 2)} tok/s), einsum-dispatch oracle "
+            f"{round(einsum_tok_s, 2)} tok/s on the same geometry; "
+            "vs_baseline = MFU (active-param gpt2_moe counter)",
+            "dispatch_impl": "index",
+            "index_tok_s": round(index_tok_s, 2),
+            "einsum_tok_s": round(einsum_tok_s, 2),
+            "dense_tok_s": round(dense_tok_s, 2),
+            "vs_dense": round(vs_dense, 4),
+            "drop_rate": round(drop_rate, 4),
+            "mfu": round(index_mfu, 4),
+            "vs_baseline": round(index_mfu, 4),
+        }
+    )
+
+
 def bench_decode() -> None:
     """KV-cache autoregressive decode (tpudist.generate): GPT-2 124M,
     temperature/top-k/top-p sampling, ONE jit program for prefill + 256
@@ -2945,6 +3058,9 @@ _LEG_GROUPS = {
     "wide": (bench_gpt2_wide, 1800),
     "t5": (bench_t5, 1800),
     "families": (bench_families, 1800),
+    # sparse GPT-2: three timed sides (dense trunk, einsum-oracle MoE,
+    # index-dispatch MoE) + one moe_stats probe forward
+    "moe": (bench_moe, 2400),
     "decode": (bench_decode, 1800),  # +300s: the batch-128 serving leg
     # one static-baseline pass (3 batch shapes) + one engine warmup pass +
     # the timed continuous-batching run

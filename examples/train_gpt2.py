@@ -140,6 +140,26 @@ def parse_args(argv=None):
     p.add_argument("--experts", default=0, type=int, help="MoE experts (0=dense)")
     p.add_argument("--expert_axis", default=0, type=int,
                    help="'expert' mesh axis size (0 → min(experts, devices))")
+    p.add_argument("--moe_every", default=0, type=int,
+                   help="MoE block cadence: every Nth block is sparse "
+                   "(0 = family default: 2 for gpt2, 1/Mixtral for llama)")
+    p.add_argument("--moe_top_k", default=2, type=int,
+                   help="experts each token is routed to")
+    p.add_argument("--capacity_factor", default=1.25, type=float,
+                   help="per-expert slot headroom over the balanced load "
+                   "(tokens over capacity are dropped to the residual)")
+    p.add_argument("--moe_dispatch", default="einsum",
+                   choices=["einsum", "index"],
+                   help="expert dispatch impl (tpudist.parallel.ep): "
+                   "'einsum' = the one-hot oracle, 'index' = slot-index "
+                   "gather/scatter + the explicit expert-axis all-to-all "
+                   "on a real --expert_axis mesh (docs/PERF.md §13)")
+    p.add_argument("--router_z_loss", default=0.0, type=float,
+                   help="router z-loss weight (fp32 logit-norm regularizer; "
+                   "0 = off, byte-identical trajectory)")
+    p.add_argument("--router_jitter", default=0.0, type=float,
+                   help="multiplicative router input noise, train only "
+                   "(0 = off)")
     p.add_argument("--attn", default="auto",
                    choices=["auto", "xla", "vmem", "flash", "ring", "ulysses",
                             "ulysses_flash"],
@@ -351,6 +371,11 @@ def main(argv=None):
                 tie_embeddings=args.tie_embeddings, scan_layers=scan_layers,
                 remat_layers=remat_layers, remat_policy=args.remat_policy,
                 num_experts=args.experts,  # Mixtral-style SwiGLU experts
+                moe_every=args.moe_every or 1, moe_top_k=args.moe_top_k,
+                capacity_factor=args.capacity_factor,
+                moe_dispatch=args.moe_dispatch,
+                router_z_loss=args.router_z_loss,
+                router_jitter=args.router_jitter,
                 dtype=dtype, attn_impl=args.attn, mesh=mesh,
             )
         if args.scan_layers and (args.experts or args.generate or args.init_hf):
@@ -362,7 +387,12 @@ def main(argv=None):
             vocab_size=args.vocab_size, max_seq_len=args.seq_len,
             hidden_dim=args.hidden_dim, depth=args.depth,
             num_heads=args.num_heads, dtype=dtype, attn_impl=args.attn,
-            num_experts=args.experts, mesh=mesh, dropout=args.dropout,
+            num_experts=args.experts, moe_every=args.moe_every or 2,
+            moe_top_k=args.moe_top_k, capacity_factor=args.capacity_factor,
+            moe_dispatch=args.moe_dispatch,
+            router_z_loss=args.router_z_loss,
+            router_jitter=args.router_jitter,
+            mesh=mesh, dropout=args.dropout,
             scan_layers=scan_layers, remat_layers=remat_layers,
             remat_policy=args.remat_policy,
         )
@@ -407,8 +437,11 @@ def main(argv=None):
             return None
         from tpudist.models.gpt2 import chunked_lm_forward
 
-        if args.pipe > 1 or args.experts:
-            raise SystemExit("--chunked_ce supports the dense models only")
+        if args.pipe > 1:
+            raise SystemExit("--chunked_ce does not compose with --pipe")
+        # MoE composes: the chunked scan carries the sowed aux loss
+        # (lm_utils applies with 'losses' mutable); router jitter is the
+        # one knob it can't serve (no rng stream on the fused path)
         return chunked_lm_forward(mdl, chunk=args.chunked_ce)
 
     forward_loss = build_forward_loss(model)

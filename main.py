@@ -229,6 +229,16 @@ def parse_args(argv=None):
                         "slot and the target verifies the window in one "
                         "bulk pass; greedy output is token-identical to "
                         "the non-speculative engine (docs/SERVING.md §6)")
+    parser.add_argument("--serve_experts", default=0, type=int,
+                        help="with --serve: make every other demo-model "
+                        "block a routed top-2 MoE of this many experts "
+                        "(tpudist.parallel.ep; 0 = dense). Decode routes "
+                        "per generated token; greedy output is identical "
+                        "across dispatch impls")
+    parser.add_argument("--serve_moe_dispatch", default="einsum",
+                        choices=["einsum", "index"],
+                        help="with --serve_experts: expert dispatch impl "
+                        "(docs/PERF.md §13)")
     parser.add_argument("--spec_k", default=4, type=int,
                         help="with --spec_draft: draft tokens proposed per "
                         "slot per tick (a slot emits up to spec_k+1 "
@@ -292,8 +302,15 @@ def _serve_demo(args):
     from tpudist.serve import ServeEngine
     from tpudist.telemetry import TelemetrySink
 
+    moe_kw = {}
+    if args.serve_experts:
+        # sparse demo model: every other block routed top-2 MoE; the
+        # decode step routes each generated token (capacity auto-sizes
+        # to the one-token step, so nothing drops at decode)
+        moe_kw = dict(num_experts=args.serve_experts, moe_every=2,
+                      moe_dispatch=args.serve_moe_dispatch)
     model = GPT2(vocab_size=256, max_seq_len=256, hidden_dim=128, depth=2,
-                 num_heads=4)
+                 num_heads=4, **moe_kw)
     params = model.init(
         jax.random.key(0), np.zeros((1, 8), np.int32), train=False
     )["params"]

@@ -205,3 +205,33 @@ def test_summary_survives_corrupt_lines(bench, capsys, tmp_path):
         )
     summary = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert set(summary["legs"]) == {"ok_leg"}
+
+
+def test_moe_leg_record_pins_ab_fields(bench):
+    """The sparse-models leg (docs/PERF.md §13): scheduled in _LEG_GROUPS,
+    in the inventory the compact-summary bound covers, and its record
+    carries the einsum-vs-index A/B, the iso-active-FLOP dense comparison,
+    the drop rate, and a real MFU as FIELDS — dashboards parse fields,
+    not prose."""
+    import ast
+
+    assert "moe" in bench._LEG_GROUPS
+    assert "gpt2_moe_tokens_per_sec" in _real_leg_inventory()
+    tree = ast.parse((REPO / "bench.py").read_text())
+    found = False
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and getattr(node.func, "id", None) == "_record_line"
+                and node.args and isinstance(node.args[0], ast.Dict)):
+            continue
+        d = node.args[0]
+        keys = {k.value for k in d.keys if isinstance(k, ast.Constant)}
+        text = " ".join(
+            c.value for v in d.values for c in ast.walk(v)
+            if isinstance(c, ast.Constant) and isinstance(c.value, str)
+        )
+        if "gpt2_moe_tokens_per_sec" in text:
+            found = True
+            assert {"dispatch_impl", "vs_dense", "drop_rate", "mfu",
+                    "einsum_tok_s", "index_tok_s", "vs_baseline"} <= keys
+    assert found

@@ -218,6 +218,24 @@ def test_meta_matrix_reshard_vs_refusal():
     assert elastic_mismatch(legacy, _meta(4, world_size=4))
 
 
+def test_expert_world_resize_default_denied():
+    """The expert axis is a MODEL axis: the expert-scattered FFN stacks
+    were written under their placement and have no reshard path, so an
+    expert_world resize refuses with the same named hint as fsdp/tensor/
+    pipe — and a legacy meta (pre expert recording) compares as 1."""
+    saved = _meta(8, expert_world=2)
+    reason = refusal_reason(saved, _meta(8, expert_world=4))
+    assert reason is not None
+    assert "expert_world 2 -> 4" in reason
+    assert "only the data axis is elastic" in reason
+    # legacy meta (no expert_world) at an unchanged all-dense geometry:
+    # no refusal; resumed onto an expert-split mesh: default-denied
+    legacy = _meta(8)
+    assert refusal_reason(legacy, _meta(8, expert_world=1)) is None
+    reason = refusal_reason(legacy, _meta(8, expert_world=2))
+    assert reason is not None and "expert_world 1 -> 2" in reason
+
+
 def test_refused_reshard_raises_elastic_refusal(tmp_path):
     """A non-resize mismatch must raise the refusal — never be mistaken
     for corruption and silently walked past by the fallback."""

@@ -84,9 +84,13 @@ def test_chunked_train_step_on_mesh():
     assert losses[-1] < losses[0]
 
 
-def test_chunked_rejects_moe():
+def test_chunked_accepts_moe_but_rejects_jitter():
+    # MoE composes with the chunked path (the aux loss rides the mutable
+    # 'losses' collection — tests/test_moe.py pins the value); router
+    # jitter is the one knob the fused forward can't serve
+    chunked_lm_forward(GPT2(num_experts=4))
     with pytest.raises(ValueError):
-        chunked_lm_forward(GPT2(num_experts=4))
+        chunked_lm_forward(GPT2(num_experts=4, router_jitter=0.1))
 
 
 def test_chunked_rejects_bad_chunk():

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from tpudist import mesh as mesh_lib
 from tpudist.parallel.ep import MoEMlp, expert_capacity, top_k_dispatch
@@ -125,6 +126,313 @@ def test_expert_sharded_equals_unsharded():
 
     assert np.isfinite(losses["single"])
     np.testing.assert_allclose(losses["single"], losses["ep"], rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# index dispatch: the einsum oracle is the bit-checked reference
+
+
+def _layer(**kw):
+    kw.setdefault("num_experts", 4)
+    kw.setdefault("top_k", 2)
+    kw.setdefault("capacity_factor", 2.0)
+    return MoEMlp(**kw)
+
+
+def _x(shape=(2, 16, 16), seed=5):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+
+def _unboxed_params(layer, x, seed=0):
+    from flax import linen as nn
+
+    return nn.meta.unbox(layer.init(jax.random.key(seed), x)["params"])
+
+
+def test_index_dispatch_forward_parity():
+    """fp32, top_k=2: dispatch and the expert FFN outputs are BIT-identical
+    between impls (same slot contents, same einsums); the final gate-mix
+    matches to ≤1 ulp — the einsum oracle's contraction accumulates with
+    FMA (one rounding per term) where the index path's explicit
+    multiply-add rounds the product first (ep._index_combine docstring)."""
+    x = _x()
+    ein, idx = _layer(dispatch_impl="einsum"), _layer(dispatch_impl="index")
+    params = {"params": _unboxed_params(ein, x)}
+    y_e = np.asarray(ein.apply(params, x))
+    y_i = np.asarray(idx.apply(params, x))
+    np.testing.assert_allclose(y_e, y_i, rtol=0, atol=5e-7)
+    # …and the ulp-level agreement is real agreement, not a loose bar:
+    # outputs are O(0.1), so 5e-7 is a handful of ulps
+    assert np.max(np.abs(y_e)) > 0.05
+
+
+@pytest.mark.slow
+def test_index_dispatch_grad_parity():
+    """Backward parity: the gather's transpose is a scatter-add, so expert
+    and router grads match the einsum oracle to fp32 reduction-order
+    tolerance (the loss includes the sowed aux, exercising the routing
+    grads too)."""
+    x = _x()
+
+    def loss_fn(layer):
+        def f(p):
+            y, upd = layer.apply({"params": p}, x, mutable=["losses"])
+            aux = sum(jax.tree_util.tree_leaves(upd["losses"]), 0.0)
+            return jnp.sum(y * y) + aux
+        return f
+
+    ein, idx = _layer(dispatch_impl="einsum"), _layer(dispatch_impl="index")
+    params = _unboxed_params(ein, x)
+    g_e = jax.grad(loss_fn(ein))(params)
+    g_i = jax.grad(loss_fn(idx))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_e),
+                    jax.tree_util.tree_leaves(g_i)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+@pytest.mark.slow
+def test_moe_dense_equivalence_when_experts_identical():
+    """The dense-equivalence oracle: with every expert holding the SAME
+    weights and capacity ample, top-2 routing is a no-op — the renormalized
+    gates sum to 1 and the layer equals one dense gelu FFN."""
+    x = _x((2, 8, 12), seed=7)
+    for impl in ("einsum", "index"):
+        layer = _layer(num_experts=4, capacity_factor=4.0,
+                       dispatch_impl=impl)
+        params = _unboxed_params(layer, x)
+        params["w1"] = jnp.tile(params["w1"][:1], (4, 1, 1))
+        params["w2"] = jnp.tile(params["w2"][:1], (4, 1, 1))
+        y = layer.apply({"params": params}, x)
+        want = jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, params["w1"][0])),
+            params["w2"][0],
+        )
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+
+@pytest.mark.slow
+def test_capacity_drop_deterministic_and_impl_identical():
+    """capacity_factor < 1 forces drops; both impls drop the SAME tokens
+    (priority is token order — deterministic), so outputs are bit-stable
+    run-to-run, agree across impls (to the combine's ulp — see the
+    forward-parity test), and the dropped rate really is > 0."""
+    x = _x((2, 32, 8), seed=9)
+    outs = {}
+    for impl in ("einsum", "index"):
+        layer = _layer(num_experts=2, capacity_factor=0.5,
+                       dispatch_impl=impl)
+        params = {"params": _unboxed_params(layer, x)}
+        y1, sown = layer.apply(params, x, mutable=["moe_stats"])
+        y2 = layer.apply(params, x)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        outs[impl] = np.asarray(y1)
+        (dropped,) = [
+            leaf for path, leaf in
+            jax.tree_util.tree_flatten_with_path(sown["moe_stats"])[0]
+            if any(getattr(p, "key", None) == "dropped" for p in path)
+        ]
+        assert float(dropped) > 0.0
+    np.testing.assert_allclose(
+        outs["einsum"], outs["index"], rtol=0, atol=5e-7
+    )
+
+
+@pytest.mark.slow
+def test_index_sharded_matches_einsum_oracle():
+    """The headline composition: index dispatch under a data×expert×tensor
+    mesh (the explicit shard_map all-to-all) trains the same loss as the
+    single-device einsum oracle."""
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import (
+        create_train_state, lm_loss, make_train_step, state_shardings_of,
+    )
+
+    rng = np.random.Generator(np.random.PCG64(4))
+    tokens = {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    losses = {}
+    for name, (cfg, n_dev, impl) in {
+        "oracle": (mesh_lib.MeshConfig(data=1), 1, "einsum"),
+        "sharded": (mesh_lib.MeshConfig(data=2, expert=2, tensor=2), 8,
+                    "index"),
+    }.items():
+        mesh = mesh_lib.create_mesh(cfg, devices=jax.devices()[:n_dev])
+        model = GPT2(
+            vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+            num_heads=2, num_experts=4, moe_every=1, capacity_factor=2.0,
+            moe_dispatch=impl, mesh=mesh,
+        )
+        tx = optax.adam(1e-3)
+        # the shard_map path runs at init too: the sample batch must
+        # divide the mesh's (data, fsdp) axes, unlike the GSPMD paths'
+        # usual (1, S) probe
+        state = create_train_state(
+            model, 0, jnp.zeros((2, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens", state_sharding=state_shardings_of(state),
+        )
+        state, metrics = step(state, tokens)
+        losses[name] = float(metrics["loss"])
+    assert np.isfinite(losses["oracle"])
+    np.testing.assert_allclose(losses["sharded"], losses["oracle"], rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# router hardening: z-loss + jitter (off by default, byte-inert when off)
+
+
+@pytest.mark.slow
+def test_router_z_loss_sown_and_shrinks_logit_norms():
+    x = _x()
+    layer = _layer(router_z_loss=1.0)
+    params = _unboxed_params(layer, x)
+    # inflate the router so the z-loss has norm to shrink
+    params["router"] = params["router"] * 10.0
+
+    def zloss(p):
+        _, upd = layer.apply({"params": p}, x, mutable=["losses"])
+        return upd["losses"]["moe_router_z_loss"]
+
+    before = float(zloss(params))
+    assert np.isfinite(before) and before > 0
+    g = jax.grad(lambda p: zloss(p))(params)
+    after = float(zloss(jax.tree_util.tree_map(
+        lambda a, b: a - 1e-2 * b, params, g
+    )))
+    assert after < before, f"z-loss did not shrink: {before} -> {after}"
+    # off by default: the losses collection carries ONLY the aux loss
+    off = _layer()
+    _, upd = off.apply({"params": params}, x, mutable=["losses"])
+    assert set(upd["losses"]) == {"moe_aux_loss"}
+
+
+def test_router_jitter_gating():
+    x = _x()
+    jit_layer = _layer(router_jitter=0.2)
+    params = {"params": _unboxed_params(jit_layer, x)}
+    base = np.asarray(_layer().apply(params, x))
+    # eval (deterministic=True) and the default (None): byte-identical to
+    # the jitter-free layer — the knob is train-only
+    np.testing.assert_array_equal(
+        np.asarray(jit_layer.apply(params, x, deterministic=True)), base
+    )
+    np.testing.assert_array_equal(np.asarray(jit_layer.apply(params, x)), base)
+    # train without an rng stream: a loud refusal, not silent determinism
+    with pytest.raises(ValueError, match="dropout' rng"):
+        jit_layer.apply(params, x, deterministic=False)
+    # train with the stream: the routing input actually moves
+    noisy = np.asarray(jit_layer.apply(
+        params, x, deterministic=False, rngs={"dropout": jax.random.key(1)}
+    ))
+    assert not np.array_equal(noisy, base)
+
+
+# ---------------------------------------------------------------------------
+# composition: chunked CE, remat, step metrics
+
+
+@pytest.mark.slow
+def test_chunked_forward_carries_moe_aux():
+    """chunked_lm_forward on an MoE model: the sowed aux loss survives the
+    fused path — total == chunked-CE + aux, matching the plain forward."""
+    from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+    from tpudist.train import lm_loss
+
+    model = GPT2(
+        vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=2,
+        num_experts=4, moe_every=1, capacity_factor=2.0,
+    )
+    rng = np.random.Generator(np.random.PCG64(6))
+    tokens = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+    params = model.init(jax.random.key(0), tokens, train=False)["params"]
+    fwd = chunked_lm_forward(model, chunk=8)
+    chunked, _ = fwd(params, {}, {"tokens": tokens})
+    logits, upd = model.apply(
+        {"params": params}, tokens, train=True, mutable=["losses"]
+    )
+    aux = sum(jax.tree_util.tree_leaves(upd["losses"]), 0.0)
+    want = lm_loss(logits, tokens) + aux
+    assert float(aux) > 0  # the chunked total really includes a live aux
+    np.testing.assert_allclose(float(chunked), float(want), rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_moe_composes_with_remat_policy():
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    mesh = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(data=2, expert=2), devices=jax.devices()[:4]
+    )
+    rng = np.random.Generator(np.random.PCG64(8))
+    batch = {"tokens": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    losses = {}
+    for policy in (None, "dots_saveable"):
+        model = GPT2(
+            vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2,
+            num_heads=2, num_experts=4, capacity_factor=2.0,
+            moe_dispatch="index", remat_policy=policy, mesh=mesh,
+        )
+        tx = optax.adam(1e-3)
+        # the shard_map dispatch runs at init too: the sample batch must
+        # divide the mesh's (data, fsdp) axes.
+        state = create_train_state(
+            model, 0, jnp.zeros((2, 16), jnp.int32), tx, mesh
+        )
+        step = make_train_step(
+            model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+            label_key="tokens",
+        )
+        state, metrics = step(state, batch)
+        losses[policy] = float(metrics["loss"])
+    np.testing.assert_allclose(
+        losses["dots_saveable"], losses[None], rtol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_moe_step_metrics_behind_telemetry_flag():
+    """Router stats ride the step metrics ONLY under telemetry=True
+    (docs/OBSERVABILITY.md §1): load is per-expert [E] summing to
+    1 − dropped; with telemetry off the keys are absent entirely."""
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    mesh = mesh_lib.create_mesh(
+        mesh_lib.MeshConfig(data=2), devices=jax.devices()[:2]
+    )
+    model = GPT2(
+        vocab_size=64, max_seq_len=16, hidden_dim=32, depth=2, num_heads=2,
+        num_experts=4, capacity_factor=2.0, mesh=mesh,
+    )
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, jnp.zeros((1, 16), jnp.int32), tx, mesh)
+    rng = np.random.Generator(np.random.PCG64(11))
+    batch = {"tokens": rng.integers(0, 64, (4, 16)).astype(np.int32)}
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", telemetry=True,
+    )
+    state, metrics = step(state, batch)  # the step donates its input state
+    # depth 2, moe_every 2 → block h_1 is the MoE block
+    load = np.asarray(metrics["moe/h_1/load"])
+    dropped = float(metrics["moe/h_1/dropped"])
+    assert load.shape == (4,)
+    np.testing.assert_allclose(float(load.sum()), 1.0 - dropped, rtol=1e-5)
+    assert np.isfinite(float(metrics["moe/h_1/aux"]))
+    plain = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens",
+    )
+    _, metrics = plain(state, batch)
+    assert not [k for k in metrics if k.startswith("moe/")]
 
 
 def test_moe_gpt2_loss_decreases():

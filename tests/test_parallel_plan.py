@@ -348,7 +348,7 @@ def test_elastic_denies_model_axis_resize_with_hint():
     assert reason is not None
     assert "fsdp_world 2 -> 4" in reason
     assert "only the data axis is elastic" in reason
-    assert "MeshConfig(fsdp=2, tensor=2, pipe=1)" in reason
+    assert "MeshConfig(fsdp=2, tensor=2, pipe=1, expert=1)" in reason
     assert not elastic.elastic_mismatch(saved, run)
 
 
@@ -460,6 +460,118 @@ def test_train_state_budget_accepts_plan():
             < repl["opt_state_bytes_per_chip"])
     assert sharded["per_chip_total_bytes"] < repl["per_chip_total_bytes"]
     assert sharded["params_bytes_global"] == repl["params_bytes"]
+
+
+# -- the expert column of the grid ----------------------------------------
+
+
+def _moe_trajectory(plan, *, zero1=False, n_steps=3):
+    """Loss trajectory of a sparse (MoE) GPT-2: a composed cell runs
+    index dispatch over the plan's expert axis; ``plan=None`` is the
+    pure-DP einsum oracle on the full default mesh."""
+    mesh = plan.mesh if plan is not None else mesh_lib.create_mesh()
+    model = GPT2(
+        **_GPT2_CFG, num_experts=4, capacity_factor=2.0,
+        moe_dispatch="index" if plan is not None else "einsum", mesh=mesh,
+    )
+    tx = optax.adam(1e-3)
+    # the sharded index dispatch runs at init too: the sample batch must
+    # divide the plan's (data, fsdp) axes
+    sample = jnp.zeros((2, 16), jnp.int32)
+    if plan is None:
+        state = create_train_state(model, 0, sample, tx, mesh)
+    else:
+        if zero1:
+            boxed = jax.eval_shape(
+                model.init, jax.random.PRNGKey(0), sample
+            )["params"]
+            tx = plan.wrap_zero1(tx, params=boxed)
+        state = create_train_state(model, 0, sample, tx, plan=plan)
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", state_sharding=state_shardings_of(state),
+        plan=plan,
+    )
+    losses = []
+    for batch in _batches(n_steps):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+@pytest.mark.slow
+def test_moe_grid_cell_matches_pure_dp_oracle():
+    """data=2 × expert=2 with ZeRO-1 (index dispatch, all-to-all wire
+    format) trains the SAME trajectory as the pure-DP einsum oracle:
+    expert placement is placement, not math. Same tolerance as the dense
+    grid (fp32 reduction-order drift through 3 Adam steps)."""
+    want = _moe_trajectory(None)
+    got = _moe_trajectory(_plan(data=2, expert=2), zero1=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_plan_expert_axis_worlds_and_reduce_refusal():
+    """The expert axis joins the plan's geometry meta (axis_worlds) and
+    its model_axes — so the explicit bucketed/quantized reducer refuses
+    an expert plan loudly, naming the axis to move."""
+    plan = _plan(data=2, expert=2)
+    assert plan.axis_worlds()["expert_world"] == 2
+    assert plan.model_axes == {"expert": 2}
+    for method in ("bucketed", "quantized"):
+        with pytest.raises(ValueError) as e:
+            plan.validate_reduce(method)
+        msg = str(e.value)
+        assert "expert=2" in msg and "reduce='none'" in msg
+
+
+def test_wrap_zero1_skips_expert_sharded_leaves():
+    """ZeRO-1 on an expert plan must not flatten the expert-scattered
+    FFN stacks out from under their placement: their shapes join the
+    skip set (moments keep the natural shape) while ordinary leaves
+    still get the pad-and-reshape data layout."""
+    import flax.linen as nn
+
+    plan = _plan(data=2, expert=2)
+    model = GPT2(
+        **_GPT2_CFG, num_experts=4, capacity_factor=2.0, mesh=plan.mesh
+    )
+    boxed = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    tx = plan.wrap_zero1(optax.scale_by_adam(), params=boxed)
+    concrete = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), nn.meta.unbox(boxed)
+    )
+    state = tx.init(concrete)
+
+    def _by_key(tree, needle):
+        return [
+            leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+            if needle in jax.tree_util.keystr(path)
+        ]
+
+    # skipped: the expert stacks keep their natural shape in the moments
+    w1_mu = _by_key(state.mu, "w1")
+    assert w1_mu and all(v.shape == (4, 32, 128) for v in w1_mu)
+    # ...and the bare wrapper leaves them out of its data layout, while a
+    # dense leaf (the token embedding) is data-sharded as usual
+    sh = tx.state_shardings(concrete)
+    assert all(
+        not spec_is_sharded(s.spec, plan.mesh)
+        for s in _by_key(sh.mu, "w1")
+    )
+    assert all(
+        DATA_AXIS in jax.tree_util.tree_leaves(tuple(s.spec))
+        for s in _by_key(sh.mu, "wte")
+    )
+    # the plan's metadata overlay then restores the expert placement on
+    # the skipped mirrors — sharded state either way, never flattened
+    composed = plan.opt_state_shardings(boxed, tx)
+    from tpudist.mesh import EXPERT_AXIS
+
+    for s in _by_key(composed.mu, "w1"):
+        assert EXPERT_AXIS in jax.tree_util.tree_leaves(tuple(s.spec))
 
 
 def test_marker_audit_covers_the_world_drill_module():

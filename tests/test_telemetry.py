@@ -128,10 +128,11 @@ def test_dispatch_returns_none_not_zero():
     model = GPT2(vocab_size=64, hidden_dim=32, depth=1, num_heads=2)
     assert flops.train_step_flops(model, {"_idx": np.zeros(4)}) is None
     assert flops.tokens_per_step(model, {"_idx": np.zeros(4)}) is None
-    # MoE GPT-2: dense counter would miscount routed experts
+    # MoE GPT-2: the dense counter would miscount routed experts — sparse
+    # geometries carry their own active-param counter instead of None
     moe = GPT2(vocab_size=64, hidden_dim=32, depth=2, num_heads=2,
                num_experts=4)
-    assert moe.flops_counter is None
+    assert moe.flops_counter == "gpt2_moe"
     # non-50-layer basic-block resnet: tagged, but the geometry has no
     # counter — None, never a guessed constant
     r18 = resnet18(num_classes=10)
@@ -144,6 +145,36 @@ def test_dispatch_returns_none_not_zero():
         3.0 * flops.RESNET50_FWD_FLOPS_224 * 8
     )
     assert flops.tokens_per_step(r50, imgs, input_key="image") == 8
+
+
+def test_moe_dispatch_reads_active_geometry():
+    """Sparse models get REAL MFU numerators: the dispatch reads the MoE
+    knobs off the model and routes to the active-param counters — the
+    sparse count sits strictly between "experts were free" (dense count)
+    and "every expert ran" (top_k < E)."""
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.models.llama import Llama
+
+    batch = {"tokens": np.zeros((4, 16), np.int32)}
+    moe = GPT2(vocab_size=64, hidden_dim=32, depth=2, num_heads=2,
+               num_experts=4, moe_every=2, moe_top_k=2)
+    got = flops.train_step_flops(moe, batch)
+    assert got == flops.gpt2_moe_train_flops(
+        64.0, hidden=32, depth=2, vocab=64, seq=16,
+        num_experts=4, moe_every=2, top_k=2,
+    )
+    dense = flops.gpt2_train_flops(64.0, hidden=32, depth=2, vocab=64,
+                                   seq=16)
+    assert got > dense  # router + the second active expert aren't free
+
+    lm = Llama(vocab_size=64, hidden_dim=96, depth=2, num_heads=2,
+               ffn_dim=64, num_experts=4, moe_every=1, moe_top_k=2)
+    assert lm.flops_counter == "llama_moe"
+    got = flops.train_step_flops(lm, batch)
+    assert got == flops.llama_moe_train_flops(
+        64.0, hidden=96, depth=2, ffn_dim=64, vocab=64, seq=16,
+        num_heads=2, num_kv_heads=2, num_experts=4, moe_every=1, top_k=2,
+    )
 
 
 def test_t5_and_vit_dispatch():

@@ -226,3 +226,39 @@ def test_fit_reduce_streams_comm_rows(tmp_path):
     # health rows see the dequantized-grad counters, still clean ints
     health = [r for r in rows if r["kind"] == "health"]
     assert health and all(r["nonfinite_grad_count"] == 0 for r in health)
+
+
+def test_fit_moe_rows_and_real_moe_mfu(tmp_path):
+    """Router observability end-to-end (docs/OBSERVABILITY.md §1): a
+    sparse fit() writes 'moe' rows on the health cadence — per-layer load
+    fractions [E] summing to 1 − dropped — and its 'mfu' rows carry the
+    ACTIVE-param flops counter (MoE MFU is a real number, not None)."""
+    model = GPT2(vocab_size=VOCAB, max_seq_len=16, hidden_dim=32, depth=2,
+                 num_heads=2, num_experts=4, capacity_factor=2.0)
+    state, losses = fit(
+        model, optax.adam(1e-3), _loader(), epochs=2, job_id="MO",
+        batch_size=16, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", log_dir=str(tmp_path), telemetry=True,
+        profile=False,
+    )
+    assert all(np.isfinite(losses))
+    rows = _rows(tmp_path / "MO_telemetry_0.jsonl")
+    moe = [r for r in rows if r["kind"] == "moe"]
+    assert moe  # cadence steps of the 8-step run
+    for r in moe:
+        load = r["h_1/load"]
+        assert isinstance(load, list) and len(load) == 4
+        np.testing.assert_allclose(sum(load), 1.0 - r["h_1/dropped"],
+                                   rtol=1e-5)
+        assert np.isfinite(r["h_1/aux"])
+    mfu = [r for r in rows if r["kind"] == "mfu"]
+    assert mfu
+    from tpudist.telemetry import flops
+
+    want = flops.gpt2_moe_train_flops(
+        16.0 * 16, hidden=32, depth=2, vocab=VOCAB, seq=16,
+        num_experts=4, moe_every=2, top_k=2,
+    )
+    for r in mfu:
+        assert r["flops_per_step"] == want
+        assert r["mfu"] is not None and r["mfu"] > 0

@@ -42,6 +42,13 @@ class Block(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # expert dispatch implementation (tpudist.parallel.ep): "einsum" (the
+    # one-hot oracle) or "index" (slot-index gather/scatter + explicit
+    # expert-axis all-to-all on a real expert mesh axis)
+    moe_dispatch: str = "einsum"
+    # router hardening knobs (off by default, byte-inert when 0.0)
+    router_z_loss: float = 0.0
+    router_jitter: float = 0.0
     mesh: Any = None
     # residual dropout (GPT-2 uses 0.1); needs a 'dropout' rng when > 0 and
     # train=True — tpudist.train supplies a per-step key automatically
@@ -166,9 +173,12 @@ class Block(nn.Module):
 
             y = MoEMlp(
                 num_experts=self.num_experts, top_k=self.moe_top_k,
-                capacity_factor=self.capacity_factor, dtype=self.dtype,
+                capacity_factor=self.capacity_factor,
+                dispatch_impl=self.moe_dispatch,
+                router_z_loss=self.router_z_loss,
+                router_jitter=self.router_jitter, dtype=self.dtype,
                 mesh=self.mesh, name="moe",
-            )(y)
+            )(y, deterministic=not train)
         else:
             y = nn.Dense(
                 4 * d, dtype=self.dtype, name="mlp_fc",
@@ -221,6 +231,11 @@ class GPT2(nn.Module):
     moe_every: int = 2
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # dispatch impl + router hardening, threaded into every MoE block
+    # (see Block / tpudist.parallel.ep.MoEMlp)
+    moe_dispatch: str = "einsum"
+    router_z_loss: float = 0.0
+    router_jitter: float = 0.0
     mesh: Any = None
     dropout: float = 0.0  # embedding + residual dropout (GPT-2 paper: 0.1)
     # scan_layers=True runs the depth as ONE nn.scan'd block (params stacked
@@ -252,10 +267,11 @@ class GPT2(nn.Module):
     @property
     def flops_counter(self) -> str | None:
         """Analytic-FLOPs family tag (tpudist.telemetry.flops) — the MFU
-        numerator dispatch. None for MoE geometries: the dense counter
-        would miscount routed experts, and a wrong MFU is worse than no
-        MFU row."""
-        return None if self.num_experts > 0 else "gpt2"
+        numerator dispatch. MoE geometries get their own counter
+        ("gpt2_moe": active-param accounting — routed experts count
+        ``top_k`` FFNs per MoE block plus the router GEMM), so MFU rows
+        stay real for sparse models."""
+        return "gpt2_moe" if self.num_experts > 0 else "gpt2"
 
     def init_cache(self, batch_size: int):
         """Zeroed decode KV cache for ``batch_size`` rows — the serving
@@ -383,6 +399,9 @@ class GPT2(nn.Module):
                     self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
                     num_experts=self.num_experts if moe_here else 0,
                     moe_top_k=self.moe_top_k, capacity_factor=self.capacity_factor,
+                    moe_dispatch=self.moe_dispatch,
+                    router_z_loss=self.router_z_loss,
+                    router_jitter=self.router_jitter,
                     mesh=self.mesh, dropout=self.dropout,
                     fused_ln=self.fused_ln, name=f"h_{i}",
                 )(x, train, decode, self.max_seq_len,

@@ -71,6 +71,10 @@ class LlamaBlock(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # expert dispatch impl + router hardening (tpudist.parallel.ep.MoEMlp)
+    moe_dispatch: str = "einsum"
+    router_z_loss: float = 0.0
+    router_jitter: float = 0.0
     # fused_ln=True runs both RMSNorms through the Pallas fused
     # residual-add+norm kernel (tpudist.ops.layernorm, rms=True — same
     # "scale" param as nn.RMSNorm). Decode keeps the reference composition.
@@ -216,8 +220,11 @@ class LlamaBlock(nn.Module):
                 num_experts=self.num_experts, top_k=self.moe_top_k,
                 capacity_factor=self.capacity_factor,
                 ffn_dim=self.ffn_dim, expert_act="swiglu",
+                dispatch_impl=self.moe_dispatch,
+                router_z_loss=self.router_z_loss,
+                router_jitter=self.router_jitter,
                 dtype=self.dtype, mesh=self.mesh, name="moe",
-            )(y)
+            )(y, deterministic=not train)
         else:
             # SwiGLU: silu(gate)·up, both column-parallel; down row-parallel
             gate = nn.Dense(self.ffn_dim, use_bias=False, dtype=self.dtype,
@@ -308,6 +315,10 @@ class Llama(nn.Module):
     moe_every: int = 1  # Mixtral: every block is MoE
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # dispatch impl + router hardening, threaded into every MoE block
+    moe_dispatch: str = "einsum"
+    router_z_loss: float = 0.0
+    router_jitter: float = 0.0
     # fused_ln=True: every RMSNorm (attn_norm/mlp_norm/final norm) runs
     # the Pallas fused residual-add+norm kernel (tpudist.ops.layernorm,
     # rms=True) — same param tree, decode path untouched. Usually set via
@@ -321,9 +332,10 @@ class Llama(nn.Module):
     @property
     def flops_counter(self) -> str | None:
         """Analytic-FLOPs family tag (tpudist.telemetry.flops) — the MFU
-        numerator dispatch. None for MoE geometries: the dense counter
-        would miscount routed experts."""
-        return None if self.num_experts > 0 else "llama"
+        numerator dispatch. MoE geometries use "llama_moe" (active-param
+        accounting: top_k SwiGLU experts + router GEMM per MoE block), so
+        MFU rows stay real for sparse models."""
+        return "llama_moe" if self.num_experts > 0 else "llama"
 
     def init_cache(self, batch_size: int):
         """Zeroed decode KV cache for ``batch_size`` rows — the serving
@@ -398,6 +410,9 @@ class Llama(nn.Module):
                     num_experts=self.num_experts if moe_here else 0,
                     moe_top_k=self.moe_top_k,
                     capacity_factor=self.capacity_factor,
+                    moe_dispatch=self.moe_dispatch,
+                    router_z_loss=self.router_z_loss,
+                    router_jitter=self.router_jitter,
                     name=f"layer_{i}",
                 )(x, train, decode, self.max_seq_len,
                   # only the (remat-free) decode path threads per-slot

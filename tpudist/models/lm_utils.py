@@ -141,35 +141,50 @@ def chunked_lm_forward(model, chunk: int = 256):
     body, so live logits are bounded by [B, chunk, V] in both passes (the
     backward recomputes each chunk's logits instead of storing them).
 
-    Works for any model with the ``return_hidden`` contract (GPT-2, Llama).
+    Works for any model with the ``return_hidden`` contract (GPT-2, Llama),
+    including MoE variants: their sowed load-balance losses (the ``losses``
+    collection, tpudist.parallel.ep) are collected from the blocks pass and
+    added to the chunked CE — the aux loss survives the chunked path.
     Returns a ``forward_loss`` for :func:`tpudist.train.make_train_step`:
     ``(params, batch_stats, batch) -> (loss, batch_stats)``. Mean CE over
     all positions — identical math to ``lm_loss`` on full logits.
-    MoE models are not supported here (their sowed aux losses need the
-    default forward); use the plain path for ``num_experts > 0``.
     """
-    if getattr(model, "num_experts", 0):
-        raise ValueError("chunked_lm_forward does not support MoE models")
     if getattr(model, "dropout", 0.0):
         raise ValueError(
             "chunked_lm_forward does not support dropout (the fused path "
             "has no rng stream); use the default forward"
         )
+    if getattr(model, "router_jitter", 0.0):
+        raise ValueError(
+            "chunked_lm_forward does not support router_jitter (the fused "
+            "path has no rng stream); use the default forward"
+        )
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    wants_aux = bool(getattr(model, "has_aux_loss", False))
 
     def forward_loss(params, batch_stats, batch):
         tokens = batch["tokens"]
-        hidden = model.apply(
-            {"params": params}, tokens, train=True, return_hidden=True
-        )
+        aux = 0.0
+        if wants_aux:
+            hidden, updates = model.apply(
+                {"params": params}, tokens, train=True, return_hidden=True,
+                mutable=["losses"],
+            )
+            aux = sum(
+                jax.tree_util.tree_leaves(updates.get("losses", {})), 0.0
+            )
+        else:
+            hidden = model.apply(
+                {"params": params}, tokens, train=True, return_hidden=True
+            )
         h = hidden[:, :-1]
         targets = tokens[:, 1:]
         b, s, _ = h.shape
         total = chunked_ce_sum(
             lm_head_weight(params), h, targets, jnp.ones((b, s)), chunk
         )
-        return total / (b * s), batch_stats
+        return total / (b * s) + aux, batch_stats
 
     # the hook make_train_step(fused="ln") uses to re-close this loss over
     # its fused_ln model clone (the closure above captured `model`; a

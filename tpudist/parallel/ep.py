@@ -1,26 +1,49 @@
-"""Expert parallelism — Mixture-of-Experts with GShard-style einsum dispatch
-over the ``expert`` mesh axis.
+"""Expert parallelism — Mixture-of-Experts with GShard-style routing over
+the ``expert`` mesh axis, with two dispatch implementations.
 
 No reference counterpart (SURVEY.md §2.12: the reference's only strategy is
 DDP, /root/reference/main.py:83); built so the framework scales parameter
 count past dense models. TPU-native design:
 
-- **Static shapes everywhere.** Routing is expressed as dense one-hot
-  dispatch/combine tensors (the GShard/Switch formulation), not gather/
-  scatter with data-dependent sizes: each expert has a fixed ``capacity``
-  slot count and tokens beyond capacity are dropped (their contribution is
-  zero; transformer residuals carry them through unchanged). XLA sees only
-  einsums — all of it tiles onto the MXU.
+- **Static shapes everywhere.** Each expert has a fixed ``capacity`` slot
+  count and tokens beyond capacity are dropped (their contribution is zero;
+  transformer residuals carry them through unchanged). Routing itself is
+  shared (:func:`top_k_routing`: argmax/cumsum slot assignment with the
+  GShard priority rule); what differs is how tokens reach their slots:
+
+  - ``dispatch_impl="einsum"`` — the GShard/Switch one-hot formulation:
+    dense ``[t, E, C]`` dispatch/combine tensors contracted on the MXU.
+    O(t·E·C) FLOPs and bytes, but every op is an einsum; this is the
+    bit-checked oracle the index path is certified against.
+  - ``dispatch_impl="index"`` — slot-index gather/scatter: each kept
+    (token, choice) computes its flat slot id ``e·C + pos``; a scatter of
+    token ids builds the slot→token map, one ``take`` gathers tokens into
+    ``[E, C, d]`` slots, and the combine is a gather from the expert
+    outputs whose backward is the scatter-add. O(t·k) index work instead
+    of O(t·E·C) — the dense one-hots never materialize.
+
 - **Expert placement = sharding metadata.** Stacked expert FFN weights
-  ``[E, d, ff]`` carry ``nn.with_partitioning(..., ('expert', ...))``; the
-  dispatched activations ``[E, capacity, d]`` are sharding-constrained to
-  ``P('expert')`` on the expert dim. From those two constraints GSPMD derives
-  the token all-to-all (data-sharded tokens → expert-sharded slots and back)
-  and schedules it on ICI — there is no hand-written collective, mirroring
-  how tpudist's DP lets XLA derive the gradient all-reduce (SURVEY.md §2.5).
+  ``[E, d, ff]`` carry ``nn.with_partitioning(..., ('expert', ...))``. On
+  the einsum path the dispatched activations are sharding-constrained to
+  ``P('expert')`` and GSPMD derives the token all-to-all. On the index
+  path with a real (>1) ``expert`` axis the collective is EXPLICIT: a
+  ``shard_map`` over the mesh in which each expert shard gathers only its
+  own experts' slots from its (expert-replicated) local tokens, runs its
+  local FFNs, and one ``all_gather`` over ``expert`` ships the slot
+  OUTPUTS back — wire bytes equal dispatched-token bytes
+  (``G·E·C·d``·dtype per direction), not whatever GSPMD derives from the
+  one-hot einsums.
 - **Load balance is a differentiable aux loss** (Switch-style
-  ``E · Σ_e f_e·P_e``), sowed into the ``losses`` collection; the train step
-  (tpudist.train) adds any sowed losses to the task loss.
+  ``E · Σ_e f_e·P_e``), sowed into the ``losses`` collection; the train
+  step (tpudist.train) adds any sowed losses to the task loss. Optional
+  router hardening: ``router_z_loss`` (penalizes ``logsumexp(logits)²``,
+  keeping the fp32 router's logits from drifting to magnitudes where
+  softmax saturates) and ``router_jitter`` (multiplicative uniform input
+  noise, train-only) — both off by default and byte-inert when off.
+- **Router observability**: per-expert load fractions, the dropped-token
+  rate, and the unscaled aux value are sowed into the ``moe_stats``
+  collection; the train step forwards them to telemetry when it runs with
+  ``telemetry=True`` (docs/OBSERVABILITY.md §1).
 """
 
 from __future__ import annotations
@@ -31,7 +54,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from tpudist.mesh import EXPERT_AXIS, TENSOR_AXIS
+from tpudist.mesh import DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, TENSOR_AXIS
 
 
 def expert_capacity(
@@ -45,25 +68,33 @@ def expert_capacity(
     return max(1, math.ceil(base * capacity_factor))
 
 
-def top_k_dispatch(probs: jax.Array, top_k: int, capacity: int):
-    """Router probabilities → (dispatch, combine, aux_loss).
+def top_k_routing(probs: jax.Array, top_k: int, capacity: int):
+    """Router probabilities → per-(token, choice) routing decisions.
 
-    ``probs``: ``[T, E]`` softmax router output.
-    ``dispatch``: ``[T, E, C]`` 0/1 — token t occupies slot c of expert e.
-    ``combine``: ``dispatch`` weighted by the token's (renormalized) gate.
-    ``aux_loss``: Switch-style load-balance loss, 1.0 at perfect balance.
+    ``probs``: ``[T, E]`` softmax router output. Returns
+    ``(idx, gates, pos, keep, aux_loss)`` with ``idx`` ``[T, k]`` int32
+    expert choices, ``gates`` ``[T, k]`` the (renormalized) gate weights,
+    ``pos`` ``[T, k]`` int32 slot positions within the chosen expert,
+    ``keep`` ``[T, k]`` bool capacity survival, and the Switch-style
+    load-balance ``aux_loss`` (1.0 at perfect balance).
 
-    Slot assignment order is token order (cumsum over the token dim), with
-    all k-th choices placed after all (k-1)-th choices — the GShard priority
-    rule, so a token's secondary expert never evicts another's primary.
+    This is the ONE routing implementation both dispatch paths consume:
+    slot assignment order is token order (int32 cumsum over the token dim
+    — a float cumsum in low-precision dtypes would collide positions),
+    with all k-th choices placed after all (k-1)-th choices (the GShard
+    priority rule, so a token's secondary expert never evicts another's
+    primary). Top-1 (Switch) keeps the raw gate — renormalizing a single
+    gate to ~1 would zero the router's task-loss gradient; top-k≥2
+    renormalizes the kept gates to sum to 1 (GShard).
     """
     T, E = probs.shape
-    gates, masks = [], []
+    gates, idxs, masks = [], [], []
     p = probs
     for _ in range(top_k):
         idx = jnp.argmax(p, axis=-1)
         m = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [T, E]
         gates.append(jnp.sum(p * m, axis=-1))  # [T]
+        idxs.append(idx.astype(jnp.int32))
         masks.append(m)
         p = p * (1.0 - m)
 
@@ -72,45 +103,147 @@ def top_k_dispatch(probs: jax.Array, top_k: int, capacity: int):
     pr = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(f * pr)
 
-    # top-1 (Switch) keeps the raw gate — renormalizing a single gate to ~1
-    # would zero the router's task-loss gradient; top-k≥2 renormalizes the
-    # kept gates to sum to 1 (GShard)
     if top_k > 1:
         denom = sum(gates) + 1e-9
         gates = [g / denom for g in gates]
 
-    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
-    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    poss, keeps = [], []
     counts = jnp.zeros((E,), jnp.int32)  # slots consumed by earlier choices
-    for g, m in zip(gates, masks):
-        # positions in int32 — a float cumsum in low-precision dtypes (bf16
-        # tops out at 256) would collide positions and double-book slots
+    for m in masks:
         mi = m.astype(jnp.int32)
         pos = jnp.cumsum(mi, axis=0) - mi + counts  # [T, E]
         pos_t = jnp.sum(pos * mi, axis=-1)  # [T]
         keep = (pos_t < capacity) & (jnp.sum(mi, axis=-1) > 0)
-        slot = jax.nn.one_hot(pos_t, capacity, dtype=probs.dtype)
-        d = m[:, :, None] * slot[:, None, :] * keep[:, None, None]
-        dispatch = dispatch + d
-        combine = combine + d * g[:, None, None]
+        poss.append(pos_t)
+        keeps.append(keep)
         counts = counts + jnp.sum(mi, axis=0)
+    return (
+        jnp.stack(idxs, axis=-1),
+        jnp.stack(gates, axis=-1),
+        jnp.stack(poss, axis=-1),
+        jnp.stack(keeps, axis=-1),
+        aux_loss,
+    )
+
+
+def _one_hot_dispatch(idx, gates, pos, keep, num_experts: int, capacity: int,
+                      dtype):
+    """Routing decisions → dense one-hot ``(dispatch, combine)`` tensors
+    (``[..., E, C]``), the GShard einsum formulation. Sequential adds in
+    choice order — the exact op order of the original oracle."""
+    shape = idx.shape[:-1] + (num_experts, capacity)
+    dispatch = jnp.zeros(shape, dtype)
+    combine = jnp.zeros(shape, dtype)
+    for j in range(idx.shape[-1]):
+        m = jax.nn.one_hot(idx[..., j], num_experts, dtype=dtype)
+        slot = jax.nn.one_hot(pos[..., j], capacity, dtype=dtype)
+        d = m[..., :, None] * slot[..., None, :] * keep[..., j, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gates[..., j, None, None]
+    return dispatch, combine
+
+
+def top_k_dispatch(probs: jax.Array, top_k: int, capacity: int):
+    """Router probabilities → (dispatch, combine, aux_loss) — the einsum
+    oracle's dense form.
+
+    ``dispatch``: ``[T, E, C]`` 0/1 — token t occupies slot c of expert e.
+    ``combine``: ``dispatch`` weighted by the token's (renormalized) gate.
+    ``aux_loss``: Switch-style load-balance loss, 1.0 at perfect balance.
+
+    Built from :func:`top_k_routing` (one routing implementation for both
+    dispatch paths); numerics are unchanged from the original fused loop.
+    """
+    idx, gates, pos, keep, aux_loss = top_k_routing(probs, top_k, capacity)
+    E = probs.shape[-1]
+    dispatch, combine = _one_hot_dispatch(
+        idx, gates, pos, keep, E, capacity, probs.dtype
+    )
     return dispatch, combine, aux_loss
+
+
+def _flat_dest(idx, pos, keep, capacity: int, num_experts: int):
+    """Per-(token, choice) flat slot id ``e·C + pos``; dropped choices
+    point at the one-past-the-end garbage slot ``E·C``."""
+    return jnp.where(keep, idx * capacity + pos, num_experts * capacity)
+
+
+def _index_dispatch(tokens, dest, num_experts: int, capacity: int):
+    """Tokens → ``[E, C, d]`` slots via slot-index scatter/gather.
+
+    ``tokens``: ``[t, d]``; ``dest``: ``[t, k]`` flat slot ids
+    (:func:`_flat_dest`). A scatter of token ids builds the slot→token
+    map (kept destinations are unique by construction — one token per
+    slot — so the scatter is order-independent and deterministic; all
+    dropped pairs collide harmlessly on the garbage slot), then ONE
+    gather materializes the slots. Empty slots read the appended zero row
+    — the same zeros the einsum dispatch produces. The gather's backward
+    is a scatter-add into the token gradients.
+    """
+    t, d = tokens.shape
+    k = dest.shape[-1]
+    n_slots = num_experts * capacity
+    token_ids = jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32)[:, None], (t, k)
+    )
+    # index t (one past the tokens) marks "empty": it reads the zero row
+    slot_token = jnp.full((n_slots + 1,), t, jnp.int32)
+    slot_token = slot_token.at[dest.reshape(-1)].set(token_ids.reshape(-1))
+    tokens_pad = jnp.concatenate(
+        [tokens, jnp.zeros((1, d), tokens.dtype)], axis=0
+    )
+    slots = jnp.take(tokens_pad, slot_token[:n_slots], axis=0)
+    return slots.reshape(num_experts, capacity, d)
+
+
+def _index_combine(out, dest, gates, keep, dtype):
+    """Expert outputs → per-token mix via gather.
+
+    ``out``: ``[E, C, d]``; ``dest``/``gates``/``keep``: ``[t, k]``.
+    ``y[t] = Σ_j gate_j·keep_j·out[dest_j]`` — dropped choices gather the
+    appended zero row. Sequential adds in choice order; the gate weights
+    are cast exactly like the einsum path's combine tensor
+    (``dtype(gate·keep)``). Dispatch and the expert outputs match the
+    oracle BIT-exactly (tests/test_moe.py asserts it on the composed
+    layer); this final mix matches to ≤1 ulp — the oracle's contraction
+    accumulates with FMA (one rounding per term), this explicit
+    multiply-add rounds the product first — which greedy decode and the
+    train-loss trajectory absorb (both pinned by tests)."""
+    E, C, d = out.shape
+    out_pad = jnp.concatenate(
+        [out.reshape(E * C, d), jnp.zeros((1, d), out.dtype)], axis=0
+    )
+    w = (gates * keep.astype(gates.dtype)).astype(dtype)  # [t, k]
+    y = jnp.zeros((dest.shape[0], d), dtype)
+    for j in range(dest.shape[-1]):
+        y = y + w[:, j, None] * jnp.take(out_pad, dest[:, j], axis=0)
+    return y
 
 
 class MoEMlp(nn.Module):
     """Mixture-of-experts FFN (drop-in for a transformer's dense MLP).
 
     ``x: [batch, seq, d] → [batch, seq, d]``; top-``top_k`` routing into
-    ``num_experts`` gelu FFNs of width ``mlp_ratio·d``; expert weights are
-    expert-sharded (and FFN-dim tensor-sharded) via partitioning metadata.
-    Sows the scaled load-balance loss into the ``losses`` collection.
+    ``num_experts`` FFNs of width ``mlp_ratio·d`` (or ``ffn_dim``); expert
+    weights are expert-sharded (and FFN-dim tensor-sharded) via
+    partitioning metadata. Sows the scaled load-balance loss into the
+    ``losses`` collection and router stats into ``moe_stats``.
 
     Routing is **grouped** (GShard): tokens are split into ``num_groups``
     independent dispatch groups (default: one per batch row, so groups ride
-    the existing ``data`` sharding) and capacity is per-group. This keeps the
-    dispatch/combine one-hots at O(group_size²·E⁻¹) instead of O(T²·E⁻¹) —
-    ungrouped routing over batch·seq tokens would put multi-hundred-MB
-    mostly-zero tensors in HBM at realistic LM shapes.
+    the existing ``data`` sharding) and capacity is per-group. On the
+    einsum path this keeps the dispatch/combine one-hots at
+    O(group_size²·E⁻¹) instead of O(T²·E⁻¹); the index path never builds
+    them at all.
+
+    ``dispatch_impl`` selects the dispatch formulation (module docstring):
+    ``"einsum"`` (default, the oracle) or ``"index"``. With a real (>1)
+    ``expert`` mesh axis the index path runs inside an explicit
+    ``shard_map``: local dispatch + local expert FFNs + ONE ``all_gather``
+    of the slot outputs over ``expert`` (wire bytes = dispatched-token
+    bytes); the per-block ``tensor`` reduction stays a ``psum``, and the
+    batch axes stay data-manual — gradients under ``jax.grad`` transpose
+    the ``all_gather`` into the matching ``psum_scatter``.
     """
 
     num_experts: int
@@ -123,11 +256,20 @@ class MoEMlp(nn.Module):
     expert_act: str = "gelu"
     aux_loss_weight: float = 0.01
     num_groups: int = 0  # 0 → one group per batch row
+    # "einsum" (one-hot oracle) | "index" (slot-index gather/scatter +
+    # explicit expert all-to-all on a real expert axis)
+    dispatch_impl: str = "einsum"
+    # router z-loss weight (ST-MoE): penalizes mean(logsumexp(logits)²),
+    # sowed into ``losses`` scaled. 0.0 = off (byte-inert).
+    router_z_loss: float = 0.0
+    # multiplicative uniform router-input jitter in [1-j, 1+j], train-only
+    # (needs a 'dropout' rng and deterministic=False). 0.0 = off.
+    router_jitter: float = 0.0
     dtype: Any = jnp.float32
     mesh: Any = None  # when set, activations get explicit expert shardings
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool | None = None):
         b, s, d = x.shape
         E = self.num_experts
         ff = self.ffn_dim or self.mlp_ratio * d
@@ -135,6 +277,11 @@ class MoEMlp(nn.Module):
         T = b * s
         if T % G:
             raise ValueError(f"{T} tokens not divisible into {G} groups")
+        if self.dispatch_impl not in ("einsum", "index"):
+            raise ValueError(
+                f"dispatch_impl must be 'einsum' or 'index', got "
+                f"{self.dispatch_impl!r}"
+            )
         t = T // G
         tokens = x.reshape(G, t, d)
 
@@ -142,19 +289,54 @@ class MoEMlp(nn.Module):
         wr = self.param(
             "router", nn.initializers.lecun_normal(), (d, E), jnp.float32
         )
-        probs = jax.nn.softmax(
-            jnp.einsum("gtd,de->gte", tokens.astype(jnp.float32), wr)
-        )
+        rin = tokens.astype(jnp.float32)
+        if (self.router_jitter > 0.0 and deterministic is False
+                and not self.is_initializing()):
+            if not self.has_rng("dropout"):
+                raise ValueError(
+                    "router_jitter > 0 needs a 'dropout' rng stream at "
+                    "train time (tpudist.train supplies one per step); "
+                    "pass rngs={'dropout': key} or set router_jitter=0"
+                )
+            j = self.router_jitter
+            rin = rin * jax.random.uniform(
+                self.make_rng("dropout"), rin.shape, jnp.float32,
+                1.0 - j, 1.0 + j,
+            )
+        logits = jnp.einsum("gtd,de->gte", rin, wr)
+        probs = jax.nn.softmax(logits)
+        if self.router_z_loss > 0.0:
+            z = jax.nn.logsumexp(logits, axis=-1)  # [G, t]
+            self.sow(
+                "losses", "moe_router_z_loss",
+                self.router_z_loss * jnp.mean(z * z),
+                reduce_fn=lambda a, b: a + b,
+                init_fn=lambda: jnp.zeros((), jnp.float32),
+            )
         capacity = expert_capacity(
             t, E, top_k=self.top_k, capacity_factor=self.capacity_factor
         )
-        dispatch, combine, aux = jax.vmap(
-            lambda p: top_k_dispatch(p, self.top_k, capacity)
+        idx, gates, pos, keep, aux = jax.vmap(
+            lambda p: top_k_routing(p, self.top_k, capacity)
         )(probs)
         self.sow(
             "losses", "moe_aux_loss", self.aux_loss_weight * jnp.mean(aux),
-            reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.zeros((), jnp.float32),
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
         )
+        # router observability (docs/OBSERVABILITY.md §1): dispatched load
+        # fraction per expert, dropped-choice rate, unscaled aux. Dead
+        # code (DCE'd) unless the caller makes 'moe_stats' mutable.
+        kept = keep.astype(jnp.float32)
+        # fraction of routed (token, choice) pairs landing on each expert:
+        # Σ_e load_e = 1 − dropped, perfectly balanced = 1/E per expert
+        load = jnp.mean(
+            jax.nn.one_hot(idx, E, dtype=jnp.float32) * kept[..., None],
+            axis=(0, 1, 2),
+        )
+        self.sow("moe_stats", "load", load)
+        self.sow("moe_stats", "dropped", 1.0 - jnp.mean(kept))
+        self.sow("moe_stats", "aux", jnp.mean(aux))
 
         def ew(name, shape, spec):
             return self.param(
@@ -165,39 +347,147 @@ class MoEMlp(nn.Module):
 
         col = (EXPERT_AXIS, None, TENSOR_AXIS)
         row = (EXPERT_AXIS, TENSOR_AXIS, None)
-
-        # tokens (data-sharded groups) → expert slots: GSPMD turns the
-        # sharding jump into the all-to-all
-        slots = jnp.einsum(
-            "gtec,gtd->gecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
-        )
-        slots = self._constrain(slots)
         if self.expert_act == "swiglu":
-            wg = ew("w_gate", (E, d, ff), col)
-            wu = ew("w_up", (E, d, ff), col)
-            wd = ew("w_down", (E, ff, d), row)
-            h = nn.silu(
-                jnp.einsum("gecd,edf->gecf", slots, wg.astype(self.dtype))
-            ) * jnp.einsum("gecd,edf->gecf", slots, wu.astype(self.dtype))
-            out = jnp.einsum("gecf,efd->gecd", h, wd.astype(self.dtype))
+            ws = (ew("w_gate", (E, d, ff), col), ew("w_up", (E, d, ff), col),
+                  ew("w_down", (E, ff, d), row))
+            specs = (col, col, row)
         elif self.expert_act == "gelu":
-            w1 = ew("w1", (E, d, ff), col)
-            w2 = ew("w2", (E, ff, d), row)
-            h = jnp.einsum("gecd,edf->gecf", slots, w1.astype(self.dtype))
-            h = nn.gelu(h)
-            out = jnp.einsum("gecf,efd->gecd", h, w2.astype(self.dtype))
+            ws = (ew("w1", (E, d, ff), col), ew("w2", (E, ff, d), row))
+            specs = (col, row)
         else:
             raise ValueError(f"unknown expert_act {self.expert_act!r}")
-        out = self._constrain(out)
-        # expert slots → tokens (the reverse all-to-all), gate-weighted
-        y = jnp.einsum("gtec,gecd->gtd", combine.astype(self.dtype), out)
+
+        ep_world = (
+            int(dict(self.mesh.shape).get(EXPERT_AXIS, 1))
+            if self.mesh is not None else 1
+        )
+        # the manual lowering splits the group dim over (data, fsdp); a
+        # trace whose batch can't split — single-row decode, init probes —
+        # takes the local formulation below and lets GSPMD place it (the
+        # dispatch/FFN math is identical, so outputs don't change)
+        dp_world = (
+            int(dict(self.mesh.shape).get(DATA_AXIS, 1))
+            * int(dict(self.mesh.shape).get(FSDP_AXIS, 1))
+            if self.mesh is not None else 1
+        )
+        if (self.dispatch_impl == "index" and ep_world > 1
+                and tokens.shape[0] % dp_world == 0):
+            y = self._sharded_index_forward(
+                tokens, idx, gates, pos, keep, ws, specs, capacity, ep_world
+            )
+        elif self.dispatch_impl == "index":
+            dest = _flat_dest(idx, pos, keep, capacity, E)
+            slots = jax.vmap(
+                lambda tk, de: _index_dispatch(
+                    tk.astype(self.dtype), de, E, capacity
+                )
+            )(tokens, dest)
+            out = self._expert_ffn(slots, ws)
+            y = jax.vmap(
+                lambda o, de, g, k: _index_combine(o, de, g, k, self.dtype)
+            )(out, dest, gates, keep)
+        else:
+            dispatch, combine = _one_hot_dispatch(
+                idx, gates, pos, keep, E, capacity, probs.dtype
+            )
+            # tokens (data-sharded groups) → expert slots: GSPMD turns the
+            # sharding jump into the all-to-all
+            slots = jnp.einsum(
+                "gtec,gtd->gecd", dispatch.astype(self.dtype),
+                tokens.astype(self.dtype),
+            )
+            slots = self._constrain(slots)
+            out = self._constrain(self._expert_ffn(slots, ws))
+            # expert slots → tokens (the reverse all-to-all), gate-weighted
+            y = jnp.einsum(
+                "gtec,gecd->gtd", combine.astype(self.dtype), out
+            )
         return y.reshape(b, s, d)
+
+    def _expert_ffn(self, slots, ws):
+        """Per-expert FFN over ``[..., E_local, C, d]`` slots; ``ws`` are
+        the (possibly locally-sharded) stacked expert weights."""
+        if self.expert_act == "swiglu":
+            wg, wu, wd = ws
+            h = nn.silu(
+                jnp.einsum("...ecd,edf->...ecf", slots, wg.astype(self.dtype))
+            ) * jnp.einsum("...ecd,edf->...ecf", slots, wu.astype(self.dtype))
+            return jnp.einsum("...ecf,efd->...ecd", h, wd.astype(self.dtype))
+        w1, w2 = ws
+        h = jnp.einsum("...ecd,edf->...ecf", slots, w1.astype(self.dtype))
+        h = nn.gelu(h)
+        return jnp.einsum("...ecf,efd->...ecd", h, w2.astype(self.dtype))
+
+    def _sharded_index_forward(self, tokens, idx, gates, pos, keep, ws,
+                               specs, capacity: int, ep_world: int):
+        """The explicit expert all-to-all: index dispatch under a manual
+        ``shard_map`` over the WHOLE mesh.
+
+        Tokens ride their existing ``(data, fsdp)`` batch sharding and are
+        REPLICATED over ``expert`` (that axis shards only weights), so
+        dispatch needs no send at all: each expert shard scatters/gathers
+        its OWN experts' slots from its local token copy and runs its
+        local FFNs. The one collective is the ``all_gather`` of the slot
+        OUTPUTS over ``expert`` — ``G·E·C·d`` dtype bytes, exactly the
+        dispatched-token volume — after which the combine is a local
+        gather. Row-parallel ``tensor`` partial sums stay a ``psum``,
+        matching the metadata the einsum path hands GSPMD."""
+        from jax.sharding import PartitionSpec as P
+
+        from tpudist.utils.compat import shard_map
+
+        E = self.num_experts
+        if E % ep_world:
+            raise ValueError(
+                f"num_experts={E} not divisible by the mesh's "
+                f"expert={ep_world} axis"
+            )
+        e_loc = E // ep_world
+        tp_world = int(dict(self.mesh.shape).get(TENSOR_AXIS, 1))
+        batch = P((DATA_AXIS, FSDP_AXIS), None, None)
+        w_specs = tuple(P(*spec) for spec in specs)
+
+        def fwd(tk, idx, gates, pos, keep, *ws_loc):
+            ei = jax.lax.axis_index(EXPERT_AXIS)
+            lo = ei * e_loc
+            # choices landing on THIS shard's experts, re-based locally;
+            # everything else collides on the local garbage slot
+            mine = keep & (idx >= lo) & (idx < lo + e_loc)
+            dest_l = jnp.where(
+                mine, (idx - lo) * capacity + pos, e_loc * capacity
+            )
+            slots = jax.vmap(
+                lambda tkg, de: _index_dispatch(
+                    tkg.astype(self.dtype), de, e_loc, capacity
+                )
+            )(tk, dest_l)  # [G_loc, e_loc, C, d]
+            out = self._expert_ffn(slots, ws_loc)
+            if tp_world > 1:
+                # row-parallel partial sums over the ffn shards
+                out = jax.lax.psum(out, TENSOR_AXIS)
+            # THE all-to-all's return leg: every shard needs every
+            # expert's outputs for its local tokens
+            outs = jax.lax.all_gather(
+                out, EXPERT_AXIS, axis=1, tiled=True
+            )  # [G_loc, E, C, d]
+            dest = _flat_dest(idx, pos, keep, capacity, E)
+            return jax.vmap(
+                lambda o, de, g, k: _index_combine(o, de, g, k, self.dtype)
+            )(outs, dest, gates, keep)
+
+        routed = P((DATA_AXIS, FSDP_AXIS), None, None)
+        return shard_map(
+            fwd,
+            mesh=self.mesh,
+            in_specs=(batch, routed, routed, routed, routed, *w_specs),
+            out_specs=batch,
+            check_vma=False,
+        )(tokens, idx, gates, pos, keep, *ws)
 
     def _constrain(self, slots):
         if self.mesh is None:
             return slots
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from tpudist.mesh import DATA_AXIS, FSDP_AXIS
 
         return jax.lax.with_sharding_constraint(
             slots,
@@ -205,5 +495,3 @@ class MoEMlp(nn.Module):
                 self.mesh, P((DATA_AXIS, FSDP_AXIS), EXPERT_AXIS, None, None)
             ),
         )
-
-

@@ -62,6 +62,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudist import mesh as mesh_lib
 from tpudist.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     FSDP_AXIS,
     PIPELINE_AXIS,
     TENSOR_AXIS,
@@ -99,12 +100,13 @@ class ParallelPlan:
 
     @classmethod
     def build(cls, *, data: int = -1, fsdp: int = 1, pipe: int = 1,
-              tensor: int = 1, devices=None, **kw) -> "ParallelPlan":
+              tensor: int = 1, expert: int = 1, devices=None,
+              **kw) -> "ParallelPlan":
         """Plan + mesh in one call — ``MeshConfig`` semantics (``-1`` =
         all remaining devices)."""
         mesh = mesh_lib.create_mesh(
             mesh_lib.MeshConfig(data=data, fsdp=fsdp, pipe=pipe,
-                                tensor=tensor),
+                                tensor=tensor, expert=expert),
             devices=devices,
         )
         return cls(mesh, **kw)
@@ -126,6 +128,10 @@ class ParallelPlan:
         return int(self.mesh.shape[TENSOR_AXIS])
 
     @property
+    def expert(self) -> int:
+        return int(dict(self.mesh.shape).get(EXPERT_AXIS, 1))
+
+    @property
     def n_chips(self) -> int:
         """Every device on the mesh — the MFU denominator's chip count
         (model axes included: per-chip FLOPs is total/chips whether a chip
@@ -141,7 +147,8 @@ class ParallelPlan:
         return {
             name: size
             for name, size in (("fsdp", self.fsdp), ("pipe", self.pipe),
-                               ("tensor", self.tensor))
+                               ("tensor", self.tensor),
+                               ("expert", self.expert))
             if size > 1
         }
 
@@ -153,12 +160,13 @@ class ParallelPlan:
             "fsdp_world": self.fsdp,
             "tensor_world": self.tensor,
             "pipe_world": self.pipe,
+            "expert_world": self.expert,
         }
 
     def describe(self) -> str:
         return (
             f"ParallelPlan(data={self.data}, fsdp={self.fsdp}, "
-            f"pipe={self.pipe}, tensor={self.tensor})"
+            f"pipe={self.pipe}, tensor={self.tensor}, expert={self.expert})"
         )
 
     # -- sharding resolution ----------------------------------------------
@@ -234,18 +242,60 @@ class ParallelPlan:
         new[i] = (FSDP_AXIS, DATA_AXIS)
         return NamedSharding(self.mesh, P(*new))
 
-    def wrap_zero1(self, tx):
+    def _names_expert(self, spec) -> bool:
+        """True iff ``spec`` names a real (>1) ``expert`` axis — the
+        expert-parallel sibling of :func:`spec_is_sharded`."""
+        if self.expert <= 1:
+            return False
+        for part in (tuple(spec) if spec is not None else ()):
+            names = part if isinstance(part, tuple) else (part,)
+            if EXPERT_AXIS in names:
+                return True
+        return False
+
+    def wrap_zero1(self, tx, params=None):
         """ZeRO-1 optimizer-state sharding composed with this plan:
         ``optim.shard_state`` over ``data``, skipping the leaves the plan
         already scatters over ``fsdp`` (sharded state either way, no
         double-sharding). The returned wrapper still advertises
         ``state_shardings``; feed the wrapped tx to
-        ``create_train_state(..., plan=self)``."""
+        ``create_train_state(..., plan=self)``.
+
+        ``params`` (optional, BOXED abstract or concrete tree): on an
+        expert-parallel plan, ZeRO-1's pad-and-reshape over ``data`` must
+        also not flatten the expert-sharded leaves out from under their
+        ``('expert', ...)`` placement. The skip rule is shape-only (the
+        optimizer sees unboxed leaves), so the expert leaves are
+        identified here by metadata and their SHAPES join the skip set —
+        their mirrors keep the expert sharding via
+        :meth:`opt_state_shardings`'s metadata overlay instead."""
         from tpudist.optim import shard_state
 
+        base_skip = self._zero1_skip if self.fsdp > 1 else None
+        expert_shapes: set[tuple] = set()
+        if params is not None and self.expert > 1:
+            specs = nn.get_partition_spec(params)
+            shapes = nn.meta.unbox(params)
+
+            def visit(spec, ref):
+                if self._names_expert(spec):
+                    expert_shapes.add(
+                        tuple(ref.shape if hasattr(ref, "shape")
+                              else np.shape(ref))
+                    )
+
+            jax.tree_util.tree_map(
+                visit, specs, shapes, is_leaf=lambda s: isinstance(s, P)
+            )
+        if expert_shapes:
+            def skip(shape):
+                if tuple(shape) in expert_shapes:
+                    return True
+                return bool(base_skip and base_skip(shape))
+        else:
+            skip = base_skip
         return shard_state(
-            tx, self.mesh, min_size=self.fsdp_min_size,
-            skip_spec=self._zero1_skip if self.fsdp > 1 else None,
+            tx, self.mesh, min_size=self.fsdp_min_size, skip_spec=skip,
         )
 
     def opt_state_shardings(self, boxed_params, tx):

@@ -90,7 +90,8 @@ ELASTIC_KEYS = frozenset(
 #: the named follow-on), so resizing any of them is DEFAULT-DENIED with
 #: a hint naming the fix. Metas written before this layer carry none of
 #: the keys and mean 1 (:func:`comparable_meta`).
-MODEL_AXIS_KEYS = ("fsdp_world", "tensor_world", "pipe_world")
+MODEL_AXIS_KEYS = ("fsdp_world", "tensor_world", "pipe_world",
+                   "expert_world")
 
 
 def refusal_reason(saved_meta: dict, run_meta: dict) -> str | None:

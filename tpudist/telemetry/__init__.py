@@ -693,6 +693,17 @@ class Telemetry:
                     device_s=None if device_s is None else round(device_s, 6),
                     **extra,
                 )
+            moe = {
+                k[len("moe/"):]: v for k, v in metrics.items()
+                if k.startswith("moe/")
+            }
+            if moe:
+                # router observability (docs/OBSERVABILITY.md §1): one row
+                # per cadence step with every MoE layer's dispatched load
+                # fractions [E], dropped-choice rate, and unscaled aux-loss
+                # value — the step metrics carry them as '<layer>/load',
+                # '<layer>/dropped', '<layer>/aux' (tpudist.train)
+                self.sink.write("moe", step, **moe)
             if self._flops_per_step is not None and interval_s > 0:
                 # 8 decimals: a tiny CPU-test model's true MFU is ~1e-8
                 # and must not round to a fake 0.0

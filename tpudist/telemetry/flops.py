@@ -19,8 +19,9 @@ shapes). These are MODEL FLOPs — recompute from remat does NOT count,
 which is what makes the metric comparable across memory policies.
 
 Dispatch is duck-typed: a model advertises its counter family via a
-``flops_counter`` property (``"gpt2"``/``"llama"``/``"t5"``/``"bert"``/
-``"vit"``/``"resnet"``); :func:`train_step_flops` reads the model's own
+``flops_counter`` property (``"gpt2"``/``"llama"``/``"gpt2_moe"``/
+``"llama_moe"``/``"t5"``/``"bert"``/``"vit"``/``"resnet"``);
+:func:`train_step_flops` reads the model's own
 geometry fields and the batch's shapes. Models without the attribute (or
 geometries without a counter, e.g. a non-50-layer ResNet) return ``None``
 — no MFU row is ever fabricated from a guessed numerator.
@@ -86,6 +87,47 @@ def llama_train_flops(tokens: float, *, hidden: int, depth: int, ffn_dim: int,
     layer_p = (2 * hidden * hidden + 2 * hidden * (num_kv_heads * dh)
                + 3 * hidden * ffn_dim)
     return (6.0 * tokens * (depth * layer_p + vocab * hidden)
+            + depth * 12.0 * tokens * seq * hidden)
+
+
+def gpt2_moe_train_flops(tokens: float, *, hidden: int, depth: int,
+                         vocab: int, seq: int, num_experts: int,
+                         moe_every: int, top_k: int,
+                         moe_ffn_dim: int | None = None) -> float:
+    """Sparse GPT-2 (tpudist.parallel.ep): ACTIVE-param accounting — each
+    token pays its dense blocks (12·H²), plus per MoE block the attention
+    4·H², the fp32 router GEMM H·E, and ``top_k`` gelu expert FFNs of
+    2·H·ffn params each. Capacity drops are NOT subtracted (the dispatch
+    einsums/gathers still move full-capacity slots, and an MFU that rose
+    when the router dropped tokens would reward imbalance); ``moe_every``
+    follows the models' placement rule (every moe_every-th block,
+    ``depth // moe_every`` MoE blocks total)."""
+    ffn = moe_ffn_dim or 4 * hidden
+    n_moe = depth // moe_every
+    moe_layer_p = (4 * hidden * hidden + hidden * num_experts
+                   + top_k * 2 * hidden * ffn)
+    weight_matmul_params = ((depth - n_moe) * 12 * hidden * hidden
+                            + n_moe * moe_layer_p + vocab * hidden)
+    return (6.0 * tokens * weight_matmul_params
+            + depth * 12.0 * tokens * seq * hidden)
+
+
+def llama_moe_train_flops(tokens: float, *, hidden: int, depth: int,
+                          ffn_dim: int, vocab: int, seq: int, num_heads: int,
+                          num_kv_heads: int, num_experts: int,
+                          moe_every: int, top_k: int) -> float:
+    """Sparse Llama (Mixtral-style): GQA attention as the dense counter,
+    per MoE block the router H·E plus ``top_k`` active SwiGLU experts
+    (3·H·ffn each) instead of the dense MLP. Same active-param convention
+    as :func:`gpt2_moe_train_flops`."""
+    dh = hidden // num_heads
+    attn_p = 2 * hidden * hidden + 2 * hidden * (num_kv_heads * dh)
+    n_moe = depth // moe_every
+    dense_layer_p = attn_p + 3 * hidden * ffn_dim
+    moe_layer_p = (attn_p + hidden * num_experts
+                   + top_k * 3 * hidden * ffn_dim)
+    return (6.0 * tokens * ((depth - n_moe) * dense_layer_p
+                            + n_moe * moe_layer_p + vocab * hidden)
             + depth * 12.0 * tokens * seq * hidden)
 
 
@@ -187,6 +229,27 @@ def train_step_flops(model: Any, batch: Mapping[str, Any], *,
             _rows(shape, 1) * seq, hidden=model.hidden_dim,
             depth=model.depth, vocab=model.vocab_size, seq=seq,
         )
+    if family == "gpt2_moe":
+        seq = shape[-1]
+        return gpt2_moe_train_flops(
+            _rows(shape, 1) * seq, hidden=model.hidden_dim,
+            depth=model.depth, vocab=model.vocab_size, seq=seq,
+            num_experts=model.num_experts, moe_every=model.moe_every,
+            top_k=model.moe_top_k,
+        )
+    if family == "llama_moe":
+        seq = shape[-1]
+        from tpudist.models.llama import default_ffn_dim
+
+        ffn = model.ffn_dim or default_ffn_dim(model.hidden_dim)
+        return llama_moe_train_flops(
+            _rows(shape, 1) * seq, hidden=model.hidden_dim,
+            depth=model.depth, ffn_dim=ffn, vocab=model.vocab_size, seq=seq,
+            num_heads=model.num_heads,
+            num_kv_heads=model.num_kv_heads or model.num_heads,
+            num_experts=model.num_experts, moe_every=model.moe_every,
+            top_k=model.moe_top_k,
+        )
     if family == "llama":
         seq = shape[-1]
         from tpudist.models.llama import default_ffn_dim
@@ -236,7 +299,7 @@ def tokens_per_step(model: Any, batch: Mapping[str, Any], *,
         shape = batch[input_key].shape
     except (KeyError, AttributeError):
         return None
-    if family in ("gpt2", "llama", "bert"):
+    if family in ("gpt2", "llama", "bert", "gpt2_moe", "llama_moe"):
         return _rows(shape, 1) * shape[-1]
     if family in ("vit", "resnet"):
         return _rows(shape, 3)

@@ -511,11 +511,39 @@ def make_train_step(
     # parallel/ep.py) declare it via ``has_aux_loss``; duck-typed models
     # without the attribute keep the plain (non-mutable) apply path
     wants_aux = bool(getattr(model, "has_aux_loss", False))
+    # MoE router observability (docs/OBSERVABILITY.md §1): when telemetry
+    # is on and the model sows router stats (tpudist.parallel.ep's
+    # 'moe_stats' collection), the forward also returns them and they ride
+    # the step metrics into the telemetry "moe" rows. Only on the plain
+    # single-pass path: the explicit reducer's grad_fn contract and the
+    # micro-scan's carry both fix the forward's return shape to
+    # (loss, stats), and router stats are a health signal, not gradient
+    # math — the restricted paths simply don't emit the rows.
+    moe_telemetry = bool(
+        telemetry and wants_aux and reducer is None and grad_accum == 1
+        and forward_loss is None
+    )
     # models with a dropout field > 0 need a 'dropout' rng each step; the
     # key is derived from the step counter so every step (and every process,
-    # identically — the mask must agree across replicas) draws fresh noise
+    # identically — the mask must agree across replicas) draws fresh noise.
+    # router_jitter (MoE router-input noise, parallel/ep.py) rides the same
+    # stream under the same derivation.
     dropout_rate = float(getattr(model, "dropout", 0.0) or 0.0)
+    jitter_rate = float(getattr(model, "router_jitter", 0.0) or 0.0)
     dropout_base = jax.random.key(dropout_seed)
+
+    def _moe_metrics(sown) -> dict:
+        """Sown 'moe_stats' tree → flat metric keys: the dict path joined
+        with '/', the MoEMlp module's own 'moe' segment elided, prefixed
+        'moe/' — e.g. ``{'h_1': {'moe': {'load': (arr,)}}}`` →
+        ``{'moe/h_1/load': arr}``."""
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(sown)[0]:
+            segs = [
+                p.key for p in path if hasattr(p, "key") and p.key != "moe"
+            ]
+            out["moe/" + "/".join(segs)] = leaf
+        return out
 
     def forward(params, batch_stats, batch, step):
         variables = {"params": params, "batch_stats": batch_stats}
@@ -525,9 +553,9 @@ def make_train_step(
         )
         mutable = (["batch_stats"] if has_stats else []) + (
             ["losses"] if wants_aux else []
-        )
+        ) + (["moe_stats"] if moe_telemetry else [])
         kwargs = {}
-        if dropout_rate > 0:
+        if dropout_rate > 0 or jitter_rate > 0:
             key = jax.random.fold_in(dropout_base, step)
             if reducer is not None:
                 # inside the explicit path's shard_map each replica sees
@@ -552,6 +580,10 @@ def make_train_step(
             new_stats = batch_stats
             aux = 0.0
         loss = loss_fn(logits, batch[label_key]) + aux
+        if moe_telemetry:
+            return loss, (new_stats, _moe_metrics(
+                updates.get("moe_stats", {})
+            ))
         return loss, new_stats
 
     if forward_loss is not None:
@@ -561,6 +593,11 @@ def make_train_step(
             raise ValueError(
                 f"model.dropout={dropout_rate} but forward_loss has no rng "
                 "stream; use the default forward or a dropout-free model"
+            )
+        if jitter_rate > 0:
+            raise ValueError(
+                f"model.router_jitter={jitter_rate} but forward_loss has "
+                "no rng stream; use the default forward or router_jitter=0"
             )
         forward = lambda params, stats, batch, step: forward_loss(params, stats, batch)
     from tpudist.remat import checkpoint as _remat_checkpoint
@@ -600,9 +637,13 @@ def make_train_step(
             if ef_res is not None:
                 new_residual = ef_res
         elif grad_accum == 1:
-            (loss, new_stats), grads = grad_fn(
+            (loss, fwd_aux), grads = grad_fn(
                 fwd_params, state.batch_stats, batch, state.step
             )
+            if moe_telemetry:
+                new_stats, moe_metrics = fwd_aux
+            else:
+                new_stats = fwd_aux
         else:
             # "_"-prefixed keys are per-step operands (e.g. the
             # DeviceCachedLoader's "_cache"), not row data: they have no
@@ -651,6 +692,8 @@ def make_train_step(
         # loss is the global-batch mean — the in-graph equivalent of the
         # reference's post-step reduce_loss (main.py:105)
         metrics = {"loss": loss}
+        if moe_telemetry:
+            metrics.update(moe_metrics)
         if reducer is not None:
             # wire bytes this step's reductions move per replica — a static
             # constant, but carried as a metric so it rides the existing
@@ -1009,15 +1052,6 @@ def fit(
             )
         mesh = plan.mesh
     mesh = mesh or mesh_lib.create_mesh()
-    if shard_opt_state:
-        if plan is not None:
-            # ZeRO-1 composed with the plan: skip the leaves the plan
-            # scatters over fsdp (no double-sharding — parallel/plan.py)
-            tx = plan.wrap_zero1(tx)
-        else:
-            from tpudist.optim import shard_state as _zero1
-
-            tx = _zero1(tx, mesh)
     world_size = world_size if world_size is not None else jax.device_count()
     global_rank = (
         global_rank if global_rank is not None else jax.process_index()
@@ -1047,6 +1081,25 @@ def fit(
             (mesh_lib.data_parallel_size(mesh), *sample_in.shape[1:]),
             sample_in.dtype,
         )
+    if shard_opt_state:
+        if plan is not None:
+            # ZeRO-1 composed with the plan: skip the leaves the plan
+            # scatters over fsdp (no double-sharding — parallel/plan.py).
+            # On an expert plan the skip rule also needs the expert-sharded
+            # leaf SHAPES (the rule is shape-only), identified from an
+            # abstract trace of the init's partitioning metadata.
+            boxed = None
+            if plan.expert > 1:
+                boxed = jax.eval_shape(
+                    lambda: model.init(
+                        jax.random.PRNGKey(0), init_input, train=False
+                    )
+                )["params"]
+            tx = plan.wrap_zero1(tx, params=boxed)
+        else:
+            from tpudist.optim import shard_state as _zero1
+
+            tx = _zero1(tx, mesh)
     state = create_train_state(model, seed, init_input, tx, mesh, plan=plan)
     if init_params is not None:
         # warm-start (e.g. an HF checkpoint through tpudist.interop):
@@ -1643,7 +1696,8 @@ def fit(
                 # Integral-preserving serialization and land 3.0 in rows
                 # documented as integer counts
                 host = {
-                    k: (int(v) if jnp.issubdtype(v.dtype, jnp.integer)
+                    k: (v.tolist() if jnp.ndim(v) > 0
+                        else int(v) if jnp.issubdtype(v.dtype, jnp.integer)
                         else float(v))
                     for k, v in dev_metrics.items()
                 }
