@@ -73,6 +73,10 @@ Legs
    contract: the 124M step compiled bare vs with in-step health metrics +
    the non-finite update guard (interleaved A/B); must stay under 2%
    step-time overhead (docs/OBSERVABILITY.md).
+13a. ``gpt2_124m_trace_overhead_pct`` — the span layer's perf contract
+   (docs/OBSERVABILITY.md §8): per-step span rows + live-exporter pushes
+   on ONE compiled 124M step (interleaved A/B, < 1% bound), with the
+   serve-side lifecycle-span toggle riding along (< 2% tok/s bound).
 13b. ``gpt2_124m_fused_tail_tokens_per_sec_per_chip`` — the step-fusion
    layer's perf contract (docs/PERF.md §4c): the 124M step unfused vs
    ``fused="all"`` (Pallas fused residual-add+LN + one-pass fused-AdamW
@@ -2421,6 +2425,157 @@ def bench_telemetry_overhead() -> None:
     )
 
 
+def bench_trace_overhead() -> None:
+    """The span layer's perf contract (docs/OBSERVABILITY.md §8): tracing
+    and the live metrics endpoint are host-side only, so turning them on
+    must cost < 1% of train step time and < 2% of serving throughput.
+
+    Train side: ONE compiled GPT-2 124M step (the span layer never touches
+    the compiled program), driven through interleaved A/B windows — OFF
+    runs the bare loop, ON additionally emits the per-step ``span`` row,
+    pushes the exporter gauges, and takes one live ``/metrics`` scrape per
+    window (the scrape happens on the HTTP thread; the push is the loop's
+    cost). value = the ON-vs-OFF step-time overhead in percent.
+
+    Serve side: the long-tail Poisson workload (prompts 16-128, budgets
+    16 + Exp(80)) on ONE contiguous 124M engine inventory — identical
+    compiled programs both sides; the A/B toggles the engine's
+    ``ServeTracer`` (per-request lifecycle spans) and scrapes once per ON
+    run. Interleaved, median of 3 per side. vs_baseline folds both bounds:
+    min(train ratio / 0.99, serve ratio / 0.98) — >= 1.0 means both hold
+    with margin."""
+    import tempfile
+    import urllib.request
+
+    from tpudist import mesh as mesh_lib
+    from tpudist.models.gpt2 import GPT2, chunked_lm_forward
+    from tpudist.serve import ServeEngine
+    from tpudist.telemetry import TelemetrySink
+    from tpudist.telemetry.trace import MetricsExporter, Tracer
+    from tpudist.train import create_train_state, lm_loss, make_train_step
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.create_mesh()
+    seq_len, micro_per_chip, grad_accum = 1024, 8, 4
+    seqs_per_step = micro_per_chip * grad_accum * n_chips
+
+    model = GPT2(dtype=jnp.bfloat16, attn_impl="vmem", mesh=mesh)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((n_chips, 16), jnp.int32), tx, mesh
+    )
+    step = make_train_step(
+        model, tx, mesh, loss_fn=lm_loss, input_key="tokens",
+        label_key="tokens", grad_accum=grad_accum,
+        forward_loss=chunked_lm_forward(model, chunk=512),
+    )
+    rng = np.random.Generator(np.random.PCG64(0))
+    n_rounds, window = 4, 8
+    batches = [
+        rng.integers(0, 50257, (seqs_per_step, seq_len)).astype(np.int32)
+        for _ in range(window)
+    ]
+    for b in batches[:3]:  # compile + warmup
+        state, metrics = step(state, {"tokens": b})
+    jax.block_until_ready(metrics["loss"])
+
+    tmp = tempfile.mkdtemp(prefix="tpudist_trace_bench_")
+    sink = TelemetrySink(f"{tmp}/Trace_telemetry_0.jsonl")
+    tracer = Tracer(sink, cat="train")
+    exporter = MetricsExporter(0)
+    scrape_url = f"http://127.0.0.1:{exporter.port}/metrics"
+    times = {"off": 0.0, "on": 0.0}
+    g = 0
+    for _ in range(n_rounds):
+        for name in ("off", "on"):
+            t0 = time.perf_counter()
+            t_prev = t0
+            for b in batches:
+                state, metrics = step(state, {"tokens": b})
+                g += 1
+                if name == "on":
+                    now = time.perf_counter()
+                    tracer.span("step", now - t_prev, step=g,
+                                data_wait_s=0.0)
+                    exporter.set(step=g, step_time_s=now - t_prev)
+                    t_prev = now
+            float(metrics["loss"])
+            if name == "on":
+                urllib.request.urlopen(scrape_url, timeout=10).read()
+            times[name] += time.perf_counter() - t0
+    train_pct = 100.0 * (times["on"] - times["off"]) / times["off"]
+
+    # -- serve side: one engine, tracer toggled between interleaved runs --
+    n_req = 24
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        state.params,
+    )
+    serve_model = GPT2(dtype=jnp.bfloat16, max_seq_len=1024,
+                       attn_impl="xla")
+    plens = rng.integers(16, 129, n_req)
+    budgets = np.minimum(16 + rng.exponential(80.0, n_req), 256.0).astype(
+        np.int32
+    )
+    prompts = [
+        rng.integers(0, 50257, (p,)).astype(np.int32) for p in plens
+    ]
+    engine = ServeEngine(serve_model, params, max_slots=8, sink=sink,
+                         stats_every=0, trace=True, metrics_port=0)
+    serve_tracer, serve_url = (
+        engine.tracer, f"http://127.0.0.1:{engine.metrics_port}/metrics"
+    )
+    for i in range(n_req):  # warmup drain: compile excluded from the A/B
+        engine.submit(prompts[i], int(budgets[i]), temperature=1.0,
+                      top_k=50)
+    engine.run()
+    rates = {"off": [], "on": []}
+    for _ in range(3):
+        for name in ("off", "on"):
+            engine.tracer = serve_tracer if name == "on" else None
+            engine.reset_stats()
+            for i in range(n_req):
+                engine.submit(prompts[i], int(budgets[i]), temperature=1.0,
+                              top_k=50)
+            engine.run()
+            if name == "on":
+                urllib.request.urlopen(serve_url, timeout=10).read()
+            rates[name].append(engine.stats.snapshot()["tokens_per_sec"])
+    engine.close()
+    exporter.close()
+    sink.close()
+    serve_off = float(np.median(rates["off"]))
+    serve_on = float(np.median(rates["on"]))
+    serve_pct = 100.0 * (serve_off - serve_on) / serve_off
+    _record_line(
+        {
+            "metric": "gpt2_124m_trace_overhead_pct",
+            "value": round(train_pct, 3),
+            "unit": "percent step-time overhead of per-step span rows + "
+            "live-exporter pushes (one /metrics scrape per window) on the "
+            "GPT-2 124M step, interleaved A/B on ONE compiled program; "
+            "serve side rides along: long-tail workload on one engine "
+            "inventory, lifecycle spans toggled — "
+            f"{round(serve_off, 1)} off vs {round(serve_on, 1)} on tok/s; "
+            "vs_baseline = min(train ratio / 0.99, serve ratio / 0.98) — "
+            ">= 1.0 meets the < 1% train / < 2% serve bounds "
+            "(docs/OBSERVABILITY.md §8)",
+            "train_overhead_pct": round(train_pct, 3),
+            "serve_overhead_pct": round(serve_pct, 3),
+            "serve_rate_on_tok_s": round(serve_on, 2),
+            "serve_rate_off_tok_s": round(serve_off, 2),
+            "vs_baseline": round(
+                min(
+                    (times["off"] / times["on"]) / 0.99,
+                    (serve_on / serve_off) / 0.98,
+                ),
+                4,
+            ),
+        }
+    )
+
+
 def bench_fusion() -> None:
     """The step-fusion layer's perf contract (docs/PERF.md §4c): the SAME
     GPT-2 124M train step (bf16, vmem attention, chunk-512 CE, 8x4 accum —
@@ -3078,6 +3233,10 @@ _LEG_GROUPS = {
     "memory": (bench_memory_discipline, 1500),
     # two compiles of the 124M step + 2x4x8 measured steps
     "telemetry": (bench_telemetry_overhead, 1800),
+    # ONE compile of the 124M step (the span layer is host-side only) +
+    # one contiguous serve inventory; the A/B toggles span emission +
+    # exporter pushes, never the compiled programs
+    "trace": (bench_trace_overhead, 2400),
     # two compiles of the 124M step (unfused + fused) + 2x4x8 measured
     # steps + three differential kernel-bandwidth probes
     "fusion": (bench_fusion, 2400),

@@ -70,6 +70,14 @@ def parse_args(argv=None):
                    "by their Megatron metadata, KV pools on the KV-head "
                    "dim) over the mesh's 'tensor' axis; num_heads must "
                    "divide it (docs/SERVING.md §7). 1 = single chip")
+    p.add_argument("--trace", action="store_true",
+                   help="per-request lifecycle span rows on the serve "
+                   "telemetry stream (queued/prefill/decode/preempted "
+                   "phases per request); stitch into a Perfetto timeline "
+                   "with tools/tracelens.py (docs/OBSERVABILITY.md §8)")
+    p.add_argument("--metrics_port", default=None, type=int,
+                   help="live Prometheus text endpoint on "
+                   "http://0.0.0.0:<port>/metrics (0 = ephemeral port)")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--log_dir", default=".", type=str)
     p.add_argument("--JobID", default="Serve", type=str)
@@ -151,8 +159,11 @@ def main(argv=None):
         )}
     engine = ServeEngine(
         model, params, max_slots=args.slots, max_queue=args.max_queue,
-        seed=args.seed, sink=sink, stats_every=10, **spec_kw, **mesh_kw,
+        seed=args.seed, sink=sink, stats_every=10, trace=args.trace,
+        metrics_port=args.metrics_port, **spec_kw, **mesh_kw,
     )
+    if engine.metrics_port is not None:
+        print(f"metrics: http://0.0.0.0:{engine.metrics_port}/metrics")
     rids = [
         engine.submit(
             pr, args.max_new, temperature=args.temperature,
@@ -168,6 +179,7 @@ def main(argv=None):
         print(f"request {r}: {len(engine.result(r))} tokens -> "
               f"{engine.result(r)}")
     snap = engine.stats.snapshot()
+    engine.close()
     sink.close()
     from tpudist.serve.stats import fmt_s
 

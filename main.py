@@ -160,6 +160,19 @@ def parse_args(argv=None):
                         "with crash forensics, and a {JobID}_report.json "
                         "end-of-run report (docs/OBSERVABILITY.md §7, "
                         "docs/MULTIHOST.md)")
+    parser.add_argument("--trace", action="store_true",
+                        help="structured span rows on the telemetry stream "
+                        "(tpudist.telemetry.trace; implies --telemetry): "
+                        "per-step spans with data-wait/dispatch/device "
+                        "breakdown, checkpoint saves, probe/repair/reshard "
+                        "markers — and per-request lifecycle spans under "
+                        "--serve. Stitch into a Perfetto timeline with "
+                        "tools/tracelens.py (docs/OBSERVABILITY.md §8)")
+    parser.add_argument("--metrics_port", default=None, type=int,
+                        help="live Prometheus text endpoint on "
+                        "http://0.0.0.0:<port>/metrics (0 = ephemeral "
+                        "port): host-side counters only, no extra device "
+                        "syncs (docs/OBSERVABILITY.md §8)")
     parser.add_argument("--hang_timeout", default=300.0, type=float,
                         help="with --health: seconds without a completed "
                         "step before the watchdog dumps thread stacks and "
@@ -345,7 +358,10 @@ def _serve_demo(args):
         )}
     engine = ServeEngine(model, params, max_slots=args.serve_slots,
                          sink=sink, stats_every=10, on_token=on_token,
+                         trace=args.trace, metrics_port=args.metrics_port,
                          **spec_kw, **mesh_kw)
+    if engine.metrics_port is not None:
+        print(f"metrics: http://0.0.0.0:{engine.metrics_port}/metrics")
     rng = np.random.Generator(np.random.PCG64(0))
     for i in range(args.serve_requests):
         engine.submit(
@@ -357,6 +373,7 @@ def _serve_demo(args):
             top_k=0 if i % 2 == 0 else 50,
         )
     engine.run()
+    engine.close()
     sink.close()
     snap = engine.stats.snapshot()
     from tpudist.serve.stats import fmt_s
@@ -616,6 +633,17 @@ def main(argv=None):
             hang_timeout_s=args.hang_timeout or None,
             hang_action=args.hang_action,
         )
+    if args.trace:
+        import dataclasses
+
+        from tpudist.telemetry import TelemetryConfig
+
+        # --trace implies --telemetry: spans ride the JSONL sink
+        telemetry = dataclasses.replace(
+            telemetry if not isinstance(telemetry, bool)
+            else TelemetryConfig(),
+            trace=True,
+        )
     state, losses = fit(
         model, tx, loader,
         epochs=args.epochs, mesh=mesh, plan=plan,
@@ -642,6 +670,7 @@ def main(argv=None):
             {"skip_window": args.skip_window} if args.repair else None
         ),
         chaos=args.chaos,
+        metrics_port=args.metrics_port,
     )
 
     if args.amp and ctx.process_index == 0:

@@ -1,0 +1,111 @@
+"""tools/schema_audit.py: the emitted-kind scan and §1-table parse on
+synthetic inputs, and — the tier-1 wiring the tool exists for — the REAL
+audit over this repo: every ``sink.write("<kind>", ...)`` call site in
+``tpudist/`` must have a row in the docs/OBSERVABILITY.md §1 schema table,
+so schema drift fails the suite the same commit it appears."""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "schema_audit", _REPO / "tools" / "schema_audit.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+schema_audit = _load()
+
+
+def test_emitted_kinds_literal_first_arg_only():
+    src = '''
+sink.write("health", step, loss=loss)
+self.sink.write(
+    "serve_summary",
+    step,
+)
+f.write(line)           # file handle — variable, not a kind literal
+buf.write("not_a_kind" if x else y)  # literal, still matches — fine:
+                                     # a documented superset is harmless
+sink.write(kind, step)  # variable kind — out of scope by design
+'''
+    assert schema_audit.emitted_kinds(src) \
+        == {"health", "serve_summary", "not_a_kind"}
+
+
+def test_documented_kinds_slices_section_one():
+    md = """# Observability
+
+## 1. The JSONL stream
+
+| kind | fields | when |
+|------|--------|------|
+| `health` | loss | cadence |
+| `span` | t0, dur_s | trace=True |
+
+## 2. Something else
+
+| `bogus` | should not count | outside §1 |
+"""
+    assert schema_audit.documented_kinds(md) == {"health", "span"}
+
+
+def test_documented_kinds_whole_doc_fallback():
+    md = "## Schema\n\n| `health` | x | y |\n| `kind` | header | row |\n"
+    # no "## 1." heading → whole-document scan; header cell skipped
+    assert schema_audit.documented_kinds(md) == {"health"}
+
+
+def test_offenders_are_emitted_minus_documented(tmp_path):
+    pkg = tmp_path / "tpudist"
+    pkg.mkdir()
+    (pkg / "a.py").write_text('sink.write("health", 1)\n')
+    (pkg / "b.py").write_text('sink.write("mystery", 1)\n')
+    emitted = schema_audit.scan_tree(pkg)
+    assert emitted == {"health": {"tpudist/a.py"},
+                       "mystery": {"tpudist/b.py"}}
+    # documented-but-never-emitted is NOT an offense
+    bad = schema_audit.offenders(emitted, {"health", "retired_kind"})
+    assert bad == [("mystery", ["tpudist/b.py"])]
+
+
+def test_cli_exit_codes(tmp_path):
+    (tmp_path / "tpudist").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "tpudist" / "m.py").write_text(
+        'sink.write("undocumented_kind", 1)\n'
+    )
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "## 1. Stream\n\n| `health` | x | y |\n"
+    )
+    r = subprocess.run(
+        [sys.executable, str(_REPO / "tools" / "schema_audit.py"),
+         str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == schema_audit.EXIT_OFFENDERS == 3
+    assert "undocumented_kind" in r.stdout
+    # make it documented → clean exit
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "## 1. Stream\n\n| `health` | x | y |\n"
+        "| `undocumented_kind` | x | y |\n"
+    )
+    r = subprocess.run(
+        [sys.executable, str(_REPO / "tools" / "schema_audit.py"),
+         str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0
+
+
+def test_real_repo_schema_is_documented():
+    """The audit this file exists to wire in: the live tree against the
+    live docs. A new row kind without a §1 table row fails here."""
+    assert schema_audit.audit(_REPO) == []

@@ -1,0 +1,252 @@
+"""tools/tracelens.py: rotation-aware segment discovery, heartbeat-based
+cross-rank clock alignment, the Perfetto trace-event emission, the latency
+report — and the PR's acceptance integration: a real traced fit() run plus
+a traced ServeEngine drain (one preemption, one repair event, an emulated
+second rank) stitched into one Perfetto-loadable trace.json whose
+per-request spans reconcile with the ServeStats SLO samples."""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import numpy as np
+import optax
+
+from tpudist.telemetry import TelemetrySink
+from tpudist.telemetry.trace import ServeTracer, Tracer
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_tracelens():
+    spec = importlib.util.spec_from_file_location(
+        "tracelens", _TOOLS / "tracelens.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+tracelens = _load_tracelens()
+
+
+# -- discovery / rotation ----------------------------------------------------
+
+
+def test_discover_orders_segments_and_filters_job(tmp_path):
+    base = tmp_path / "J_telemetry_0.jsonl"
+    for name in ("J_telemetry_0.jsonl.2", "J_telemetry_0.jsonl.10",
+                 "J_telemetry_0.jsonl.1", "J_telemetry_0.jsonl",
+                 "OTHER_telemetry_0.jsonl", "J_report.json"):
+        (tmp_path / name).write_text("")
+    chains = tracelens.discover([tmp_path], job="J")
+    assert list(chains) == [str(base)]
+    # numeric ascending (1, 2, 10 — not lexicographic), live tail LAST
+    assert [p.name for p in chains[str(base)]] == [
+        "J_telemetry_0.jsonl.1", "J_telemetry_0.jsonl.2",
+        "J_telemetry_0.jsonl.10", "J_telemetry_0.jsonl",
+    ]
+
+
+def test_rotated_stream_round_trip(tmp_path):
+    """Write a traced stream through REAL sink rotation (tiny max_bytes →
+    multiple sealed segments), then reassemble via tracelens: every row
+    survives, in write order, and the trace builds from the union."""
+    path = tmp_path / "R_telemetry_0.jsonl"
+    sink = TelemetrySink(path, max_bytes=600, run_id="rid0")
+    tr = Tracer(sink, clock=lambda: 1000.0)
+    import time
+
+    for s in range(1, 21):
+        sink.write("heartbeat", s, epoch=0, interval_s=0.1,
+                   process_index=0, host="h", mono=900.0 + s,
+                   generation=0)
+        tr.span("step", 0.1, t0=900.0 + s - 0.1, step=s)
+    sink.close()
+    segs = [p for p in tmp_path.iterdir() if ".jsonl." in p.name]
+    assert len(segs) >= 2  # rotation actually happened
+
+    chains = tracelens.discover([tmp_path], job="R")
+    rows = tracelens.read_chain(chains[str(path)])
+    assert len(rows) == 40
+    assert [r["step"] for r in rows if r["kind"] == "span"] \
+        == list(range(1, 21))  # chain order == write order
+    assert all(r["run_id"] == "rid0" for r in rows)
+    events = tracelens.to_trace_events(rows)
+    assert len([e for e in events if e["ph"] == "X"]) == 20
+
+
+def test_cross_rank_mono_alignment(tmp_path):
+    """Two ranks whose monotonic clocks have wildly different epochs but
+    whose heartbeats share wall time: after alignment, simultaneous spans
+    land at the same trace timestamp (within the alignment's resolution),
+    rather than epochs apart."""
+    rows = []
+    for rank, mono_epoch in ((0, 1000.0), (1, 500000.0)):
+        for s in range(1, 4):
+            wall = 1e9 + s  # same wall instant on both ranks
+            rows.append({"v": 1, "t": wall, "kind": "heartbeat",
+                         "rank": rank, "step": s, "mono": mono_epoch + s,
+                         "generation": 0})
+            rows.append({"v": 1, "t": wall, "kind": "span", "rank": rank,
+                         "step": s, "name": "step", "cat": "train",
+                         "ph": "X", "t0": mono_epoch + s - 1.0,
+                         "dur_s": 1.0, "generation": 0})
+    events = [e for e in tracelens.to_trace_events(rows)
+              if e.get("ph") == "X"]
+    by_step = {}
+    for e in events:
+        by_step.setdefault(e["args"]["step"], []).append(e["ts"])
+    for step, stamps in by_step.items():
+        assert len(stamps) == 2
+        assert abs(stamps[0] - stamps[1]) < 1.0, (step, stamps)
+
+
+def test_serve_spans_self_anchor(tmp_path):
+    """Serve spans carry no mono heartbeat — each row's wall ``t`` is the
+    span-close anchor. A constant write offset must cancel exactly."""
+    sink_t = [0.0]
+    sink = TelemetrySink(tmp_path / "s.jsonl", clock=lambda: sink_t[0])
+    tr = ServeTracer(sink)
+    tr.on_submit(1, 10.0)
+    sink_t[0] = 1e6 + 12.0  # wall = span clock + 1e6, exactly
+    tr.on_admit(1, 12.0)
+    tr.on_first_token(1, 13.0, slot=0)
+    sink_t[0] = 1e6 + 15.0
+    tr.on_done(1, 15.0, 3)
+    sink.close()
+    rows = [json.loads(l)
+            for l in (tmp_path / "s.jsonl").read_text().splitlines()]
+    events = [e for e in tracelens.to_trace_events(rows)
+              if e.get("ph") == "X"]
+    req = next(e for e in events if e["name"] == "request")
+    queued = next(e for e in events if e["name"] == "queued")
+    # rebased to the earliest span: queued starts at 0, request too
+    assert req["ts"] == queued["ts"] == 0.0
+    assert req["dur"] == 5e6  # 5 s in µs
+
+
+def test_report_tables(tmp_path, capsys):
+    rows = [
+        {"v": 1, "t": 1.0, "kind": "span", "rank": 0, "name": "request",
+         "cat": "serve", "ph": "X", "t0": 0.0, "dur_s": 2.0, "rid": 9,
+         "lane": 1, "tokens": 5, "queued_s": 0.5, "prefill_s": 0.5,
+         "decode_s": 1.0, "preempt_s": 0.0, "preempts": 0},
+        {"v": 1, "t": 1.0, "kind": "span", "rank": 0, "name": "request",
+         "cat": "serve", "ph": "X", "t0": 0.0, "dur_s": 4.0, "rid": 3,
+         "lane": 0, "tokens": 7, "queued_s": 1.0, "prefill_s": 1.0,
+         "decode_s": 1.5, "preempt_s": 0.5, "preempts": 1},
+    ]
+    top = tracelens.request_table(rows, top=1)
+    assert [r["rid"] for r in top] == [3]  # slowest first
+    tracelens.render_report(rows, [tmp_path], None, top=5)
+    out = capsys.readouterr().out
+    assert "slowest 2 request(s)" in out and "4000.0" in out
+
+
+# -- the acceptance integration ----------------------------------------------
+
+
+def test_fit_plus_serve_trace_end_to_end(tmp_path, monkeypatch, capsys):
+    """The PR's acceptance run: a traced fit() (rotation forced, live
+    metrics endpoint on), an emulated second train rank with a repair
+    event, and a traced paged ServeEngine drain with a real preemption —
+    tracelens stitches all streams into a Perfetto-loadable trace.json
+    whose request spans reconcile with ServeStats within float error."""
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.data.loader import DataLoader
+    from tpudist.resilience.exitcodes import RUN_ID_ENV
+    from tpudist.serve import ServeEngine
+    from tpudist.telemetry import Telemetry, TelemetryConfig
+    from tpudist.train import fit
+
+    monkeypatch.setenv(RUN_ID_ENV, "acceptance01")
+    job = "TL"
+    # -- train rank 0: a real traced fit() with rotation + divergence probe
+    rng = np.random.Generator(np.random.PCG64(0))
+    tokens = rng.integers(0, 254, (64, 16)).astype(np.int32)
+    model = GPT2(vocab_size=256, max_seq_len=16, hidden_dim=32, depth=1,
+                 num_heads=2)
+    cfg = TelemetryConfig(trace=True, heartbeat_every=2,
+                          divergence_every=4, jsonl_max_bytes=4096,
+                          run_report=False)
+    from tpudist.train import lm_loss
+
+    fit(model, optax.adam(1e-3), DataLoader({"tokens": tokens}, 16),
+        epochs=3, job_id=job, batch_size=16, loss_fn=lm_loss,
+        input_key="tokens", label_key="tokens", log_dir=str(tmp_path),
+        telemetry=cfg, profile=False, metrics_port=0)
+
+    # -- train rank 1 (emulated second process): the same production
+    # wiring fit uses, driven directly — including the bring-up repair
+    # replay path that re-emits a repair event as a span
+    sink1 = TelemetrySink(tmp_path / f"{job}_telemetry_1.jsonl", rank=1)
+    tel1 = Telemetry(TelemetryConfig(trace=True), sink1, rank=1,
+                     world_size=2, log_every=2, n_chips=1)
+    tel1.tracer = Tracer(sink1, process_index=1)
+    tel1.set_repair({"action": "rollback", "cause": "loss_spike",
+                     "skip_from": 6, "skip_to": 10, "rollback_step": 4})
+    for g in range(1, 7):
+        tel1.on_step(g, {"loss": 2.0 / g}, epoch=0, interval_s=0.01,
+                     data_wait_s=0.001)
+    tel1.shutdown()
+
+    # -- serve: traced paged engine sized to force one preemption
+    smodel = GPT2(vocab_size=64, max_seq_len=64, hidden_dim=32, depth=2,
+                  num_heads=4)
+    import jax
+
+    sparams = smodel.init(
+        jax.random.key(1), np.zeros((1, 8), np.int32), train=False
+    )["params"]
+    ssink = TelemetrySink(tmp_path / f"{job}_serve_0.jsonl")
+    eng = ServeEngine(smodel, sparams, max_slots=3, seed=0, paged=True,
+                      block_size=8, n_blocks=8, watermark_blocks=0,
+                      prefix_cache=False, sink=ssink, trace=True)
+    srng = np.random.Generator(np.random.PCG64(5))
+    for _ in range(3):
+        eng.submit(srng.integers(0, 64, (6,)).astype(np.int32), 12)
+    eng.run()
+    ssink.close()
+    assert eng.stats.preemptions > 0  # the preemption actually happened
+
+    # -- stitch
+    out = tmp_path / "trace.json"
+    rc = tracelens.main([str(tmp_path), "--job", job, "--out", str(out),
+                         "--top", "3"])
+    assert rc == 0
+    trace = json.loads(out.read_text())  # Perfetto-loadable strict JSON
+    events = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    x = [e for e in events if e.get("ph") == "X"]
+    names = {e["name"] for e in x}
+    assert {"step", "queued", "prefill", "decode", "request",
+            "tick", "preempted"} <= names
+    assert any(e["ph"] == "i" and e["name"] == "repair" for e in events)
+    assert any(e["ph"] == "i" and e["name"] == "preempt" for e in events)
+    assert any(e["ph"] == "i" and e["name"] == "probe" for e in events)
+    # both train ranks present, plus named serve slot tracks
+    assert {e["pid"] for e in x if e["name"] == "step"} == {0, 1}
+    tnames = {e["args"]["name"] for e in events
+              if e.get("name") == "thread_name"}
+    assert "steps" in tnames and "serve scheduler" in tnames
+    assert any(n.startswith("serve slot") for n in tnames)
+    # every event timestamp is non-negative after rebasing
+    assert all(e["ts"] >= 0 for e in x)
+    # per-request reconciliation with the live ServeStats SLO samples
+    reqs = [e for e in x if e["name"] == "request"]
+    assert len(reqs) == 3
+    assert sorted(e["args"]["ttft_s"] for e in reqs) \
+        == sorted(eng.stats.ttft)
+    for e in reqs:
+        a = e["args"]
+        phase_sum = (a["queued_s"] + a["prefill_s"] + a["decode_s"]
+                     + a["preempt_s"])
+        assert abs(phase_sum - e["dur"] / 1e6) < 1e-6
+    # rotation happened on the fit stream and the run_id groups it all
+    fit_files = list(tmp_path.glob(f"{job}_telemetry_0.jsonl*"))
+    assert len(fit_files) >= 2
+    report = capsys.readouterr().out
+    assert "run_id acceptance01" in report
+    assert "slowest 3 request(s)" in report

@@ -1,0 +1,134 @@
+"""Telemetry schema audit: every JSONL row kind the code can emit must be
+documented in docs/OBSERVABILITY.md §1.
+
+The JSONL stream's schema table (docs/OBSERVABILITY.md §1) is the contract
+offline consumers — dashboards, tools/tracelens.py, post-mortem scripts —
+program against. Nothing enforced that the table keeps up with the code: a
+new ``sink.write("<kind>", ...)`` call site ships a new row kind silently,
+and the first consumer to meet it learns about the schema drift from a
+KeyError in production.
+
+This module statically scans ``tpudist/**/*.py`` for sink-write call sites
+whose first argument is a string literal (the row kind), parses the
+backticked first-column cells out of the §1 schema table, and FAILS (exit
+status 3, same convention as tools/marker_audit.py) listing any emitted
+kind the table is missing. Literal-first-arg extraction is deliberate: the
+``TelemetrySink.write`` convention is a literal kind at every call site,
+so the scan has no false negatives to chase through dataflow.
+
+Two ways to run it:
+
+- ``python tools/schema_audit.py`` — audits the repo this file lives in;
+  exit 0 clean, 3 with undocumented kinds listed.
+- ``tests/test_schema_audit.py`` — the tier-1 test wrapper: unit-tests
+  the pure logic on synthetic inputs AND runs the real audit, so an
+  undocumented kind fails the suite the same commit it appears.
+
+Pure logic lives in :func:`emitted_kinds` / :func:`documented_kinds` /
+:func:`offenders` so it is unit-testable without touching the real tree.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+EXIT_OFFENDERS = 3
+
+# a sink-write call site with a literal row kind: `.write("kind", ...)` /
+# `.write(\n    "kind", ...)`. The attribute spelling (`.write(`) rather
+# than a bare name keeps file-handle writes like `f.write(line)` out —
+# those pass variables, not kind literals, and the literal requirement
+# filters the rest.
+_WRITE_RE = re.compile(r"""\.write\(\s*["']([A-Za-z_][A-Za-z0-9_]*)["']""")
+
+# a §1 schema-table row: `| `kind` | fields | when |`
+_ROW_RE = re.compile(r"^\|\s*`([A-Za-z_][A-Za-z0-9_]*)`\s*\|")
+
+
+def emitted_kinds(source: str) -> set[str]:
+    """Row kinds a module can emit: string-literal first arguments of
+    ``.write(...)`` call sites (newline-tolerant — the wrapped calls the
+    line length limit produces)."""
+    return set(_WRITE_RE.findall(source))
+
+
+def documented_kinds(md_text: str) -> set[str]:
+    """Backticked first-column cells of every markdown table row in the
+    §1 section (from the first ``## 1.`` heading to the next ``## ``).
+    Falls back to the whole document when the section heading is missing
+    — a renumbered doc should not make the audit vacuously fail."""
+    lines = md_text.splitlines()
+    start = next(
+        (i for i, ln in enumerate(lines) if ln.startswith("## 1.")), None
+    )
+    if start is not None:
+        end = next(
+            (
+                i for i in range(start + 1, len(lines))
+                if lines[i].startswith("## ")
+            ),
+            len(lines),
+        )
+        lines = lines[start:end]
+    out = set()
+    for ln in lines:
+        m = _ROW_RE.match(ln)
+        # skip the header separator and the header row itself ("kind")
+        if m and m.group(1) not in ("kind", "field"):
+            out.add(m.group(1))
+    return out
+
+
+def scan_tree(pkg_dir: Path) -> dict[str, set[str]]:
+    """``{kind: {relative paths emitting it}}`` over every ``.py`` under
+    ``pkg_dir``."""
+    by_kind: dict[str, set[str]] = {}
+    for path in sorted(pkg_dir.rglob("*.py")):
+        try:
+            source = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        for kind in emitted_kinds(source):
+            by_kind.setdefault(kind, set()).add(
+                str(path.relative_to(pkg_dir.parent))
+            )
+    return by_kind
+
+
+def offenders(emitted: dict[str, set[str]],
+              documented: set[str]) -> list[tuple[str, list[str]]]:
+    """``(kind, sorted emitting files)`` for every emitted kind absent
+    from the schema table, sorted by kind. Documented-but-never-emitted
+    kinds are NOT offenders — the table may legitimately describe rows a
+    feature branch removed behind a flag."""
+    return [
+        (kind, sorted(paths))
+        for kind, paths in sorted(emitted.items())
+        if kind not in documented
+    ]
+
+
+def audit(repo: Path) -> list[tuple[str, list[str]]]:
+    md = (repo / "docs" / "OBSERVABILITY.md").read_text(encoding="utf-8")
+    return offenders(scan_tree(repo / "tpudist"), documented_kinds(md))
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    repo = Path(args[0]) if args else Path(__file__).resolve().parent.parent
+    bad = audit(repo)
+    if not bad:
+        print("schema audit: every emitted row kind is documented in "
+              "docs/OBSERVABILITY.md §1")
+        return 0
+    print(f"schema audit FAILED: {len(bad)} emitted row kind(s) missing "
+          "from the docs/OBSERVABILITY.md §1 schema table:")
+    for kind, paths in bad:
+        print(f"  {kind}  (emitted by {', '.join(paths)})")
+    return EXIT_OFFENDERS
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

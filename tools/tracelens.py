@@ -1,0 +1,358 @@
+"""tracelens: stitch per-rank telemetry JSONL streams into one Perfetto
+timeline plus a latency report (docs/OBSERVABILITY.md §8).
+
+The telemetry subsystem writes one JSONL stream per process, rotated into
+numbered segments, each on its own clocks: span rows carry ``t0``/``dur_s``
+on the emitter's *span clock* (``time.monotonic`` for train spans — the
+heartbeat ``mono`` domain — and the ServeStats ``time.perf_counter`` clock
+for serve spans), with the row's own wall ``t`` stamped at write time.
+Wall clocks skew across hosts and span clocks have arbitrary epochs, so no
+single stream is a timeline by itself. This tool is the offline other half
+of the contract:
+
+1. **discover** — expand the given files/directories into rotation chains
+   (``X.jsonl.1``, ``.2``, …, base last — the sink's sealing order) and
+   read each chain oldest→newest.
+2. **align** — per (rank, generation), place the train span clock on the
+   wall timeline via the heartbeat pairs (offset = median of ``t − mono``);
+   serve spans self-anchor the same way (each row's ``t`` is written at
+   span close, so offset = median of ``t − (t0 + dur_s)``). Medians, not
+   means: a row written during a filesystem stall is late by seconds and
+   must not drag the whole track.
+3. **emit** — a Chrome trace-event file Perfetto/``chrome://tracing``
+   loads directly: one process per rank, a ``steps`` thread for the train
+   timeline, a ``scheduler`` thread for serve ticks/queue phases, and one
+   thread per serve slot for request phase spans; instants (preempt,
+   repair, probe, anomaly, reshard) ride their track as instant events.
+4. **report** — top-K slowest requests with their exact phase
+   decomposition (the terminal ``request`` span's telescoping fields),
+   per-rank step-time stragglers, and the goodput partition when a
+   ``{job}_report.json`` is present.
+
+Usage::
+
+    python tools/tracelens.py LOGDIR [more files/dirs ...]
+        [--job JOB]          only streams of this job id
+        [--out trace.json]   Perfetto output path (default: trace.json)
+        [--top K]            rows in the slowest-request table (default 10)
+        [--no-report]        skip the text report
+
+Stdlib only — the tool must run on a laptop holding nothing but the
+downloaded log directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+# a rotated segment: `<base>.jsonl.<N>`; the base file is the live tail
+_SEG_RE = re.compile(r"^(?P<base>.+\.jsonl)\.(?P<n>\d+)$")
+
+
+# -- discovery ---------------------------------------------------------------
+
+def discover(paths, job: str | None = None) -> dict[str, list[Path]]:
+    """``{base stream name: ordered segment chain}`` — numbered segments
+    ascending (rotation seals oldest-first), base file last. Directories
+    expand to every ``*.jsonl*`` inside; ``job`` filters to streams whose
+    filename starts with ``{job}_``."""
+    files: list[Path] = []
+    for p in map(Path, paths):
+        if p.is_dir():
+            files.extend(sorted(p.glob("*.jsonl*")))
+        elif p.exists():
+            files.append(p)
+    chains: dict[str, list[tuple[int, Path]]] = {}
+    for f in files:
+        m = _SEG_RE.match(f.name)
+        base, order = (m.group("base"), int(m.group("n"))) if m \
+            else (f.name, sys.maxsize)  # the live tail sorts last
+        if job and not base.startswith(f"{job}_"):
+            continue
+        chains.setdefault(str(f.parent / base), []).append((order, f))
+    return {
+        base: [f for _, f in sorted(segs)]
+        for base, segs in sorted(chains.items())
+    }
+
+
+def read_chain(segments) -> list[dict]:
+    rows = []
+    for seg in segments:
+        with open(seg, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # a torn tail line from a crashed writer
+    return rows
+
+
+# -- clock alignment ---------------------------------------------------------
+
+def _median(xs: list[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2
+
+
+def train_offsets(rows) -> dict[tuple[int, int], float]:
+    """Wall offset of the monotonic clock per (rank, generation):
+    heartbeats carry both stamps of the same instant (``t`` wall, ``mono``
+    span clock), so ``t − mono`` is the offset; median over the stream
+    rejects stall-skewed rows. Falls back to span-close anchors
+    (``t − (t0 + dur_s)``) for a stream traced without heartbeats."""
+    pairs: dict[tuple[int, int], list[float]] = {}
+    fallback: dict[tuple[int, int], list[float]] = {}
+    for r in rows:
+        key = (int(r.get("rank", 0)), int(r.get("generation", 0)))
+        if r.get("kind") == "heartbeat" and "mono" in r:
+            pairs.setdefault(key, []).append(r["t"] - r["mono"])
+        elif r.get("kind") == "span" and r.get("cat") == "train":
+            fallback.setdefault(key, []).append(
+                r["t"] - (r["t0"] + r["dur_s"])
+            )
+    out = {k: _median(v) for k, v in pairs.items()}
+    for k, v in fallback.items():
+        out.setdefault(k, _median(v))
+    return out
+
+
+def serve_offsets(rows) -> dict[int, float]:
+    """Wall offset of the serve span clock per rank: every serve span row
+    is written at span close, so ``t − (t0 + dur_s)`` is the offset plus
+    only the write latency — the median strips the stalls."""
+    anchors: dict[int, list[float]] = {}
+    for r in rows:
+        if r.get("kind") == "span" and r.get("cat") == "serve":
+            anchors.setdefault(int(r.get("rank", 0)), []).append(
+                r["t"] - (r["t0"] + r["dur_s"])
+            )
+    return {k: _median(v) for k, v in anchors.items()}
+
+
+# -- Perfetto emission -------------------------------------------------------
+
+# serve thread layout inside a rank's process: the scheduler track, then
+# one track per slot (slot-less phases — queued, preempted, a preempt
+# instant after its slot was surrendered — ride the scheduler track)
+TID_TRAIN = 0
+TID_SCHED = 1
+TID_SLOT0 = 100
+
+
+def _tid(row) -> int:
+    if row.get("cat") != "serve":
+        return TID_TRAIN
+    slot = row.get("slot")
+    if row.get("name") in ("tick", "queued", "preempted") or slot is None:
+        return TID_SCHED
+    return TID_SLOT0 + int(slot)
+
+
+_ENVELOPE = ("v", "t", "kind", "rank", "step", "name", "cat", "ph",
+             "t0", "dur_s")
+
+
+def to_trace_events(rows) -> list[dict]:
+    """Chrome trace-event list: ``X``/``i`` events in wall microseconds
+    (rebased to the earliest span so timestamps start near zero), plus the
+    process/thread naming metadata."""
+    t_off = train_offsets(rows)
+    s_off = serve_offsets(rows)
+    spans = [r for r in rows if r.get("kind") == "span"]
+    placed = []
+    for r in spans:
+        rank = int(r.get("rank", 0))
+        if r.get("cat") == "serve":
+            off = s_off.get(rank, 0.0)
+        else:
+            off = t_off.get((rank, int(r.get("generation", 0))), 0.0)
+        placed.append((r["t0"] + off, r))
+    if not placed:
+        return []
+    epoch = min(ts for ts, _ in placed)
+    events = []
+    seen_tracks: set[tuple[int, int]] = set()
+    for ts, r in placed:
+        rank = int(r.get("rank", 0))
+        tid = _tid(r)
+        seen_tracks.add((rank, tid))
+        ev = {
+            "name": r.get("name", "?"),
+            "cat": r.get("cat", "train"),
+            "ph": r.get("ph", "X"),
+            "ts": round((ts - epoch) * 1e6, 3),
+            "pid": rank,
+            "tid": tid,
+            "args": {
+                k: v for k, v in r.items()
+                if k not in _ENVELOPE and v is not None
+            },
+        }
+        if ev["ph"] == "X":
+            ev["dur"] = round(r.get("dur_s", 0.0) * 1e6, 3)
+        else:
+            ev["s"] = "t"  # thread-scoped instant
+        if r.get("step") is not None:
+            ev["args"]["step"] = r["step"]
+        events.append(ev)
+    for rank, tid in sorted(seen_tracks):
+        if tid == TID_TRAIN:
+            tname = "steps"
+        elif tid == TID_SCHED:
+            tname = "serve scheduler"
+        else:
+            tname = f"serve slot {tid - TID_SLOT0}"
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
+            "args": {"name": tname},
+        })
+    for rank in sorted({pid for pid, _ in seen_tracks}):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+            "args": {"name": f"rank {rank}"},
+        })
+    return events
+
+
+# -- text report -------------------------------------------------------------
+
+def request_table(rows, top: int = 10) -> list[dict]:
+    """The terminal ``request`` spans, slowest first — each carries the
+    exact telescoping decomposition the tracer computed at retire."""
+    reqs = [
+        r for r in rows
+        if r.get("kind") == "span" and r.get("name") == "request"
+    ]
+    return sorted(reqs, key=lambda r: -r["dur_s"])[:top]
+
+
+def straggler_table(rows) -> list[tuple]:
+    """Per-(rank, generation) mean step-span duration against the fleet
+    median — the offline twin of the live straggler rule."""
+    per: dict[tuple[int, int], list[float]] = {}
+    for r in rows:
+        if r.get("kind") == "span" and r.get("name") == "step":
+            per.setdefault(
+                (int(r.get("rank", 0)), int(r.get("generation", 0))), []
+            ).append(r["dur_s"])
+    if not per:
+        return []
+    means = {k: sum(v) / len(v) for k, v in per.items()}
+    med = _median(list(means.values()))
+    return sorted(
+        (
+            (rank, gen, m, len(per[(rank, gen)]),
+             m / med if med > 0 else 1.0)
+            for (rank, gen), m in means.items()
+        ),
+        key=lambda t: -t[4],
+    )
+
+
+def goodput_section(paths, job: str | None) -> dict | None:
+    """The goodput partition from a run report sitting next to the
+    streams, when one exists (fit() writes ``{job}_report.json``)."""
+    for p in map(Path, paths):
+        d = p if p.is_dir() else p.parent
+        pattern = f"{job}_report.json" if job else "*_report.json"
+        for rp in sorted(d.glob(pattern)):
+            try:
+                report = json.loads(rp.read_text(encoding="utf-8"))
+            except (OSError, json.JSONDecodeError):
+                continue
+            gp = report.get("goodput")
+            if gp:
+                return {"path": str(rp), **gp}
+    return None
+
+
+def _fmt_ms(x) -> str:
+    return "n/a" if x is None else f"{x * 1e3:8.1f}"
+
+
+def render_report(rows, paths, job, top=10, out=None) -> None:
+    # resolve sys.stdout at call time, not def time — callers (and test
+    # harnesses) that swap sys.stdout must see the report
+    w = (sys.stdout if out is None else out).write
+    spans = [r for r in rows if r.get("kind") == "span"]
+    run_ids = sorted({r["run_id"] for r in rows if "run_id" in r})
+    w(f"tracelens: {len(rows)} rows, {len(spans)} spans"
+      + (f", run_id {', '.join(run_ids)}" if run_ids else "") + "\n")
+
+    reqs = request_table(rows, top)
+    if reqs:
+        w(f"\nslowest {len(reqs)} request(s) (ms; total == queued + "
+          "prefill + decode + preempted):\n")
+        w("  rid      total   queued  prefill   decode  preempt  "
+          "tok  pre  lane\n")
+        for r in reqs:
+            w(f"  {r.get('rid', '?'):>3}{_fmt_ms(r['dur_s'])}"
+              f"{_fmt_ms(r.get('queued_s'))}{_fmt_ms(r.get('prefill_s'))}"
+              f"{_fmt_ms(r.get('decode_s'))}{_fmt_ms(r.get('preempt_s'))}"
+              f"  {r.get('tokens', 0):>3}  {r.get('preempts', 0):>3}"
+              f"  {r.get('lane', 0):>4}\n")
+
+    stragglers = straggler_table(rows)
+    if stragglers:
+        w("\nper-rank step time (vs fleet median):\n")
+        for rank, gen, mean_s, n, frac in stragglers:
+            flag = "  <-- straggler" if frac > 1.5 else ""
+            w(f"  rank {rank} gen {gen}: mean {mean_s * 1e3:.1f} ms over "
+              f"{n} step span(s), {frac:.2f}x median{flag}\n")
+
+    gp = goodput_section(paths, job)
+    if gp:
+        path = gp.pop("path")
+        w(f"\ngoodput partition ({path}):\n")
+        for k, v in gp.items():
+            w(f"  {k}: {v}\n")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stitch tpudist telemetry JSONL into a Perfetto "
+        "trace.json + latency report (docs/OBSERVABILITY.md §8)"
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL files and/or log directories")
+    ap.add_argument("--job", default=None,
+                    help="only streams of this job id ({job}_*.jsonl)")
+    ap.add_argument("--out", default="trace.json",
+                    help="Perfetto trace output path")
+    ap.add_argument("--top", default=10, type=int,
+                    help="rows in the slowest-request table")
+    ap.add_argument("--no-report", action="store_true")
+    args = ap.parse_args(argv)
+
+    chains = discover(args.paths, args.job)
+    if not chains:
+        print("tracelens: no .jsonl streams found", file=sys.stderr)
+        return 2
+    rows = []
+    for base, segments in chains.items():
+        rows.extend(read_chain(segments))
+    events = to_trace_events(rows)
+    Path(args.out).write_text(
+        json.dumps({"traceEvents": events, "displayTimeUnit": "ms"}),
+        encoding="utf-8",
+    )
+    print(f"tracelens: wrote {len(events)} events from "
+          f"{len(chains)} stream(s) to {args.out}")
+    if not args.no_report:
+        render_report(rows, args.paths, args.job, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,11 +128,17 @@ def _emulated_devices(args, generation: int) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from tpudist.resilience.exitcodes import ensure_run_id
     from tpudist.resilience.supervisor import (
         BackoffPolicy, RestartBudget, Supervisor,
     )
 
     args = build_parser().parse_args(argv)
+    # one stable run id for the job's whole life: minted here (or inherited
+    # from an outer launcher), exported via the environment every child —
+    # all ranks, all restart generations — is spawned with, so telemetry
+    # rows from one logical job stitch without filename heuristics
+    ensure_run_id(os.environ)
     # one handler for the launcher's whole life, closing over the CURRENT
     # generation's procs: a SIGTERM landing between generations (previous
     # world dead, next one mid-spawn) still sets the stop flag and

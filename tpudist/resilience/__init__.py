@@ -65,9 +65,12 @@ from tpudist.resilience.exitcodes import (
     EXIT_REPAIR,
     GENERATION_ENV,
     RESTARTABLE,
+    RUN_ID_ENV,
+    ensure_run_id,
     exit_history,
     is_restartable,
     restart_generation,
+    run_id,
 )
 from tpudist.resilience.goodput import GoodputTracker
 from tpudist.resilience.preempt import Preempted, PreemptionGuard
@@ -95,9 +98,12 @@ __all__ = [
     "RESTARTABLE",
     "GENERATION_ENV",
     "EXIT_HISTORY_ENV",
+    "RUN_ID_ENV",
     "is_restartable",
     "restart_generation",
     "exit_history",
+    "run_id",
+    "ensure_run_id",
     "Preempted",
     "PreemptionGuard",
     "BackoffPolicy",

@@ -60,6 +60,12 @@ GENERATION_ENV = "TPUDIST_RESTART_GENERATION"
 #: the incident timeline across the lives of the job
 EXIT_HISTORY_ENV = "TPUDIST_EXIT_HISTORY"
 
+#: one stable id per logical job, minted once at launcher bring-up and
+#: exported to every rank and every relaunched generation — telemetry rows
+#: carry it so offline stitching (``tools/tracelens.py``) can group the
+#: segments of a multi-generation incident without filename heuristics
+RUN_ID_ENV = "TPUDIST_RUN_ID"
+
 
 def is_restartable(rc: int) -> bool:
     """True iff ``rc`` is a deliberate checkpoint-and-exit code (signal
@@ -76,6 +82,34 @@ def restart_generation(environ=None) -> int:
         return int(raw)
     except (TypeError, ValueError):
         return 0
+
+
+def run_id(environ=None) -> str | None:
+    """The job's stable run id (``TPUDIST_RUN_ID``), or ``None`` when no
+    launcher/caller exported one. Whitespace-only values count as unset —
+    telemetry must not die on a malformed environment."""
+    raw = (environ or os.environ).get(RUN_ID_ENV)
+    if raw is None:
+        return None
+    raw = str(raw).strip()
+    return raw or None
+
+
+def ensure_run_id(environ=None) -> str:
+    """Read-or-mint the job's run id and EXPORT it into ``environ`` so
+    every child process (all ranks, all relaunched generations — the
+    supervisor spawns children with a copy of this environment) inherits
+    the same id. The launcher calls this once at bring-up; everything
+    else only *reads* via :func:`run_id`."""
+    import uuid
+
+    env = environ if environ is not None else os.environ
+    existing = run_id(env)
+    if existing is not None:
+        return existing
+    minted = uuid.uuid4().hex[:12]
+    env[RUN_ID_ENV] = minted
+    return minted
 
 
 def exit_history(environ=None) -> list[int]:

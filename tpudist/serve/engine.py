@@ -519,7 +519,8 @@ class ServeEngine:
                  watermark_blocks: int | None = None,
                  ttft_slo_s: float | None = None, compile_cache=None,
                  draft_model=None, draft_params=None, spec_k: int = 4,
-                 mesh=None):
+                 mesh=None, trace: bool = False,
+                 metrics_port: int | None = None):
         self.mesh = mesh
         self.tensor_world = 1
         self._kv_sharding = None
@@ -633,6 +634,28 @@ class ServeEngine:
             slots=max_slots, sink=sink, every=stats_every, clock=clock,
             paged=self.paged, tensor_world=self.tensor_world,
         )
+        # per-request lifecycle spans (tpudist.telemetry.trace.ServeTracer,
+        # docs/OBSERVABILITY.md §8): every hook reuses the EXACT clock
+        # reading the stats call returned, so span-derived TTFT/TPOT are
+        # bit-equal to the SLO samples. Off (the default) constructs
+        # nothing and the streams stay byte-identical.
+        self.tracer = None
+        if trace:
+            if sink is None:
+                raise ValueError("trace=True needs a sink= to write spans to")
+            from tpudist.telemetry.trace import ServeTracer
+
+            self.tracer = ServeTracer(sink)
+        # live Prometheus endpoint: a scrape-time snapshot() reader — the
+        # request hot path pays nothing for it (no pushes, no device work)
+        self.exporter = None
+        self.metrics_port: int | None = None
+        if metrics_port is not None:
+            from tpudist.telemetry.trace import MetricsExporter
+
+            self.exporter = MetricsExporter(metrics_port)
+            self.exporter.add_collector(self._metrics_snapshot)
+            self.metrics_port = self.exporter.port
         self._base_key = jax.random.key(seed)
         if self.spec:
             # second, slot-pinned KV pool for the draft (contiguous even
@@ -745,6 +768,8 @@ class ServeEngine:
         if self.retain_results:
             self._results[rid] = []
         self._t_submit[rid] = self.stats.on_submit(rid)
+        if self.tracer is not None:
+            self.tracer.on_submit(rid, self._t_submit[rid], lane=req.priority)
         return rid
 
     # -- scheduler ---------------------------------------------------------
@@ -762,6 +787,7 @@ class ServeEngine:
         """One scheduler tick: admit, dispatch, process. Returns the
         tokens emitted this tick (also delivered to ``on_token``) — a
         dispatched token surfaces on the NEXT tick's process phase."""
+        t_tick0 = None if self.tracer is None else self.stats._clock()
         events = self._admit()
         self._drained_events = []
         new_inflight = self._dispatch()
@@ -779,6 +805,12 @@ class ServeEngine:
                 self.pool.blocks.occupancy if self.paged else None
             ),
         )
+        if self.tracer is not None:
+            self.tracer.on_tick(
+                self._step, t_tick0, self.stats._clock(),
+                active=self.pool.n_active, queue_depth=self.queue_depth,
+                emitted=len(events),
+            )
         if self.on_token is not None:
             for e in events:
                 self.on_token(e)
@@ -819,7 +851,31 @@ class ServeEngine:
             tensor_world=self.tensor_world,
         )
 
+    def close(self) -> None:
+        """Release the engine's host-side services (today: the live
+        metrics endpoint's server thread). Safe to call twice; a no-op
+        when ``metrics_port`` was never given."""
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
+
     # -- internals ---------------------------------------------------------
+
+    def _occ(self) -> float | None:
+        """Block-pool occupancy at a scheduler transition (None on a
+        contiguous engine) — the pressure tag span rows carry."""
+        return self.pool.blocks.occupancy if self.paged else None
+
+    def _metrics_snapshot(self) -> dict:
+        """The live-metrics collector: host-side SLO state at scrape time
+        (``ServeStats.snapshot()`` plus the queue/slot live readings).
+        Runs on the exporter's HTTP thread — reads only host scalars, so
+        a scrape can never block or perturb the serving loop."""
+        snap = {f"serve_{k}": v for k, v in self.stats.snapshot().items()}
+        snap["serve_queue_depth"] = self.queue_depth
+        snap["serve_active"] = self.pool.n_active
+        snap["serve_preemptions_total"] = snap.pop("serve_preemptions", 0)
+        return snap
 
     def _dev(self, x):
         """Host lane → device argument. On a mesh engine the lane commits
@@ -850,7 +906,12 @@ class ServeEngine:
         """Request complete: close out its SLO accounting and (in
         streaming mode) drop its per-request state — host memory stays
         bounded by live requests, not requests ever served."""
-        self.stats.on_done(rid, self._counts.pop(rid))
+        n_tokens = self._counts.pop(rid)
+        t_done = self.stats.on_done(rid, n_tokens)
+        if self.tracer is not None:
+            self.tracer.on_done(
+                rid, t_done, n_tokens, pool_occupancy=self._occ()
+            )
         self._live_toks.pop(rid, None)
         self._t_submit.pop(rid, None)
         if not self.retain_results:
@@ -946,6 +1007,19 @@ class ServeEngine:
                 if self.pool.blocks.n_free < budget:
                     self.pool.evict_prefix(budget - self.pool.blocks.n_free)
             self._lanes[lane].popleft()
+            # admission commit: the queue-wait sample closes here (the
+            # prefill dispatch follows immediately); a replay re-admission
+            # doesn't re-sample, it closes its preempted span instead
+            t_adm = self.stats.on_prefill_start(req.request_id)
+            if self.tracer is not None:
+                if replay is None:
+                    self.tracer.on_admit(
+                        req.request_id, t_adm, pool_occupancy=self._occ()
+                    )
+                else:
+                    self.tracer.on_resume(
+                        req.request_id, t_adm, pool_occupancy=self._occ()
+                    )
             if self.paged and self.pool.prefix is not None:
                 # record the prefix outcome only for COMMITTED admissions:
                 # a budget-blocked head retries the lookup every tick, and
@@ -975,7 +1049,13 @@ class ServeEngine:
                     jnp.asarray(req.top_k, jnp.int32),
                     jnp.asarray(req.top_p, jnp.float32),
                 ))
-                self.stats.on_first_token(req.request_id)
+                t_first = self.stats.on_first_token(req.request_id)
+                if self.tracer is not None:
+                    self.tracer.on_first_token(
+                        req.request_id, t_first,
+                        prefix_hit=(len(hit_blocks) if self.paged else None),
+                        prefix_lookup=(lookup_blocks if self.paged else None),
+                    )
                 done = tok == req.eos_id or req.max_new_tokens == 1
                 events.append(self._emit(req.request_id, tok, done))
                 if done:
@@ -1005,6 +1085,8 @@ class ServeEngine:
                     self.pool.blocks.decref(int(blk))
             else:
                 slot = self.pool.insert(row_cache, len(kv_tokens))
+            if self.tracer is not None:
+                self.tracer.set_slot(req.request_id, slot)
             if self.spec:
                 # the draft's K/V for the same window, pinned to the SAME
                 # slot (shared cursor lane). Always a real prefill — the
@@ -1057,7 +1139,9 @@ class ServeEngine:
         self._slot_req.pop(victim, None)
         self.pool.release(victim)
         self._req[victim] = -1
-        self.stats.on_preempt(rid)
+        t_pre = self.stats.on_preempt(rid)
+        if self.tracer is not None:
+            self.tracer.on_preempt(rid, t_pre, pool_occupancy=self._occ())
 
     def _ensure_blocks(self, live: np.ndarray) -> np.ndarray:
         """Paged pre-dispatch pass: every live slot whose cursor crossed a
@@ -1203,6 +1287,8 @@ class ServeEngine:
             # doesn't read as rejection
             drafted += int(n_spec[slot])
             accepted += m - 1
+            if self.tracer is not None:
+                self.tracer.on_spec(rid, int(n_spec[slot]), m - 1)
             for j in range(m):
                 n = self._counts[rid]
                 finished = (

@@ -81,6 +81,13 @@ class ServeStats:
         self.tpot: collections.deque[float] = collections.deque(
             maxlen=SLO_WINDOW
         )
+        # queue-wait samples (submit → first prefill dispatch): the slice
+        # of TTFT spent waiting for admission — invisible inside the TTFT
+        # number alone, and the first thing to saturate under overload.
+        # Same bounded-deque sampling as ttft/tpot.
+        self.queue_wait: collections.deque[float] = collections.deque(
+            maxlen=SLO_WINDOW
+        )
         self._arrival: dict[int, float] = {}
         self._first: dict[int, float] = {}
         # interval accumulators (reset at each serve row)
@@ -111,7 +118,23 @@ class ServeStats:
         self._arrival[request_id] = t
         return t
 
-    def on_first_token(self, request_id: int) -> None:
+    def on_prefill_start(self, request_id: int) -> float:
+        """The request's FIRST prefill dispatch: closes the queue-wait
+        sample (submit → here). Replay re-admissions after a preemption
+        don't re-sample (the arrival entry is gone by then — first-token
+        pops it); the preemption gap is accounted separately by the span
+        layer. Returns the clock reading so the tracer's queued-phase span
+        ends on the exact timestamp the sample was taken at."""
+        t = self._clock()
+        arrival = self._arrival.get(request_id)
+        if arrival is not None and request_id not in self._first:
+            self.queue_wait.append(t - arrival)
+        return t
+
+    def on_first_token(self, request_id: int) -> float:
+        """Returns the first-token timestamp — the tracer's prefill-phase
+        span ends on the same reading the TTFT sample was computed from,
+        so span-derived TTFT is bit-equal to the SLO sample."""
         t = self._clock()
         self._first[request_id] = t
         self.ttft.append(t - self._arrival.pop(request_id, t))
@@ -119,17 +142,25 @@ class ServeStats:
         # here so throughput covers every emitted token
         self.tokens += 1
         self._win_tokens += 1
+        return t
 
-    def on_done(self, request_id: int, n_tokens: int) -> None:
+    def on_done(self, request_id: int, n_tokens: int) -> float:
+        """Returns the retire timestamp (same contract as
+        :meth:`on_first_token`: the tracer reuses the exact reading the
+        TPOT sample was computed from)."""
+        t = self._clock()
         self.completed += 1
         first = self._first.pop(request_id, None)
         if first is not None and n_tokens > 1:
-            self.tpot.append((self._clock() - first) / (n_tokens - 1))
+            self.tpot.append((t - first) / (n_tokens - 1))
+        return t
 
-    def on_preempt(self, request_id: int) -> None:
+    def on_preempt(self, request_id: int) -> float:
         """A live request was evicted back to the queue (pool ran dry);
-        its blocks freed, its prompt+progress replay at re-admission."""
+        its blocks freed, its prompt+progress replay at re-admission.
+        Returns the eviction timestamp for the span layer."""
         self.preemptions += 1
+        return self._clock()
 
     def on_prefix(self, hit_blocks: int, lookup_blocks: int) -> None:
         """One admission's prefix-cache outcome, in BLOCK units (hit rate
@@ -219,6 +250,11 @@ class ServeStats:
             "spec_acceptance_rate": self._rate(
                 self._win_spec_accepted, self._win_spec_drafted
             ),
+            # queue-wait percentiles (submit → first prefill dispatch),
+            # appended after existing fields (the append-only schema
+            # discipline): the admission-pressure slice of TTFT
+            "queue_p50": _pct(self.queue_wait, 50),
+            "queue_p95": _pct(self.queue_wait, 95),
         }
 
     def snapshot(self) -> dict:
@@ -250,6 +286,8 @@ class ServeStats:
             "spec_acceptance_rate": self._rate(
                 self.spec_accepted, self.spec_drafted
             ),
+            "queue_p50": _pct(self.queue_wait, 50),
+            "queue_p95": _pct(self.queue_wait, 95),
         }
 
     def write_summary(self, step: int) -> None:

@@ -126,6 +126,10 @@ class TelemetryConfig:
     hang_action: str = "report"
     run_report: bool = True
     jsonl_max_bytes: int | None = None
+    # span layer (tpudist.telemetry.trace) — off by default; on, fit()
+    # re-emits the step breakdown, checkpoint saves, health probes, and
+    # repair/reshard events as `span` rows on the same sink
+    trace: bool = False
 
     def step_kwargs(self) -> dict:
         """The ``make_train_step`` knobs this config implies — the ONE
@@ -202,12 +206,24 @@ class TelemetrySink:
     TAIL_ROWS = 256
 
     def __init__(self, path: str | Path, *, rank: int = 0, clock=time.time,
-                 max_bytes: int | None = None):
+                 max_bytes: int | None = None, run_id: str | None = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.rank = rank
         self._clock = clock
         self.max_bytes = max_bytes
+        # the job's stable run id: explicit > launcher env (TPUDIST_RUN_ID)
+        # > absent. When set, every row gains a `run_id` field APPENDED
+        # after its existing fields (the heartbeat append-only discipline)
+        # so offline stitching (tools/tracelens.py) can group the segments
+        # of one logical job — including relaunched generations, which
+        # inherit the id via the supervisor env — without filename
+        # heuristics. A bare sink with no launcher stays byte-identical.
+        if run_id is None:
+            from tpudist.resilience.exitcodes import run_id as _env_run_id
+
+            run_id = _env_run_id()
+        self.run_id = run_id
         self._lock = threading.Lock()
         self._tail: collections.deque = collections.deque(
             maxlen=self.TAIL_ROWS
@@ -231,6 +247,8 @@ class TelemetrySink:
         if step is not None:
             row["step"] = int(step)
         row.update({k: _json_safe(v) for k, v in fields.items()})
+        if self.run_id is not None:
+            row["run_id"] = self.run_id
         line = json.dumps(row) + "\n"
         # the cap is in BYTES on disk: a non-ASCII hostname or event
         # string is longer in UTF-8 than in characters, and len(line)
@@ -491,6 +509,16 @@ class Telemetry:
         # goodput tracker (tpudist.resilience.goodput), attached by fit();
         # the run report's `goodput` section reads it. None = no section.
         self.goodput = None
+        # running skipped-update total — the exporter's counter surface
+        self._skips_total = 0
+        # span layer (tpudist.telemetry.trace.Tracer), attached by
+        # build_telemetry when config.trace; None keeps every span path a
+        # no-op and the streams byte-identical
+        self.tracer = None
+        # live-metrics exporter (tpudist.telemetry.trace.MetricsExporter),
+        # attached by fit(metrics_port=); on_step pushes host-side gauges
+        # into it — no device syncs, no extra rows
+        self.exporter = None
         # restart generation (TPUDIST_RESTART_GENERATION, exported by the
         # supervisor; 0 on a first launch): stamps heartbeat rows and the
         # run report so streams sharing one append-mode file are
@@ -535,6 +563,11 @@ class Telemetry:
         self.repair_events.append(info)
         if self.rank == 0:
             self.sink.write("repair", info.get("skip_from"), **info)
+        if self.tracer is not None:
+            self.tracer.instant(
+                "repair", step=info.get("skip_from"),
+                cause=info.get("cause"), action=info.get("action"),
+            )
 
     def reset_for_repair(self) -> None:
         """The repair loop just rolled the trajectory back: clear the
@@ -585,6 +618,12 @@ class Telemetry:
         own row (each rank restored its own shards); absent unless a
         reshard actually happened, so streams stay byte-identical."""
         self.sink.write("reshard", **dict(info))
+        if self.tracer is not None:
+            self.tracer.instant(
+                "reshard",
+                old_world=info.get("old_world"),
+                new_world=info.get("new_world"),
+            )
 
     def set_compile_cache(self, info: Mapping[str, Any]) -> None:
         """One-time ``compile_cache`` row (rank 0): the AOT executable
@@ -651,6 +690,7 @@ class Telemetry:
         loss = float(metrics.get("loss", float("nan")))
         nonfinite = int(metrics.get("nonfinite_grad_count", 0) or 0)
         skipped = int(metrics.get("update_skipped", 0) or 0)
+        self._skips_total += skipped
         cadence = step % self.log_every == 0
         mfu_val = None
 
@@ -795,6 +835,44 @@ class Telemetry:
                             mono=round(time.monotonic(), 6),
                             generation=self.generation)
 
+        if self.tracer is not None:
+            # one `span` row per RESOLVED step, per rank — the timeline form
+            # of the step_breakdown row, with the host-side attribution as
+            # args. t0 is on the tracer's monotonic clock (the heartbeat
+            # `mono` domain), so tracelens aligns ranks the same way it
+            # aligns heartbeats.
+            self.tracer.span(
+                "step", interval_s, step=step,
+                data_wait_s=round(data_wait_s or 0.0, 6),
+                dispatch_s=None if dispatch_s is None else round(dispatch_s, 6),
+                device_s=None if device_s is None else round(device_s, 6),
+            )
+            if event is not None:
+                self.tracer.instant(
+                    "anomaly", step=step, event=event.get("event")
+                )
+
+        if self.exporter is not None:
+            # live scrape surface: host-side scalars only — everything here
+            # was already fetched for the rows above, zero extra device work
+            self.exporter.set(
+                step=step,
+                loss=loss if math.isfinite(loss) else None,
+                step_time_s=round(interval_s, 6),
+                data_wait_s=round(data_wait_s or 0.0, 6),
+                mfu=mfu_val,
+                tokens_per_sec=(
+                    None
+                    if (self._tokens_per_step is None or interval_s <= 0)
+                    else round(self._tokens_per_step / interval_s, 2)
+                ),
+                anomaly_events_total=(
+                    len(self.sentry.events) if self.sentry else 0
+                ),
+                update_skips_total=self._skips_total,
+                repair_events_total=len(self.repair_events),
+            )
+
         if self.health is not None:
             # host_s is the rank-LOCAL share of the step (input wait +
             # dispatch) — the scalar that actually differs on a straggling
@@ -819,6 +897,9 @@ class Telemetry:
         cadence later on the delayed pipeline)."""
         if self.health is not None:
             self.health.observe_state(step, state)
+            if (self.tracer is not None and self.config.divergence_every
+                    and step % self.config.divergence_every == 0):
+                self.tracer.instant("probe", step=step, probe="divergence")
 
     def mark_crashing(self) -> None:
         """fit()'s exception handler calls this FIRST, before flushing the
@@ -849,6 +930,8 @@ class Telemetry:
         same ordering contract as before)."""
         if self.health is not None:
             self.health.shutdown()
+        if self.exporter is not None:
+            self.exporter.close()
         self.sink.close()
 
     def finish(self, opt_state=None, status: str = "completed") -> None:
@@ -904,14 +987,32 @@ def build_telemetry(
         return None
     config = telemetry if isinstance(telemetry, TelemetryConfig) else TelemetryConfig()
     out_dir = Path(config.jsonl_dir or log_dir)
+    # the job's stable run id: the launcher's env export when supervised
+    # (one id across all ranks and relaunched generations), else minted
+    # here — WITHOUT touching os.environ, so one fit() call in a long
+    # process (a test suite) cannot leak its id into the next
+    from tpudist.resilience.exitcodes import run_id as _env_run_id
+
+    rid = _env_run_id()
+    if rid is None:
+        import uuid
+
+        rid = uuid.uuid4().hex[:12]
     sink = TelemetrySink(
         out_dir / f"{job_id}_telemetry_{rank}.jsonl",
-        rank=rank, max_bytes=config.jsonl_max_bytes,
+        rank=rank, max_bytes=config.jsonl_max_bytes, run_id=rid,
     )
     tel = Telemetry(
         config, sink, model=model, input_key=input_key, profiler=profiler,
         rank=rank, world_size=world_size, log_every=log_every, n_chips=n_chips,
     )
+    if config.trace:
+        from tpudist.telemetry.trace import Tracer
+
+        tel.tracer = Tracer(
+            sink, cat="train",
+            process_index=tel.process_index, generation=tel.generation,
+        )
     if (config.run_report or config.aggregate_every
             or config.divergence_every or config.hang_timeout_s):
         from tpudist.telemetry.health import RunHealth

@@ -685,6 +685,10 @@ class RunHealth:
         from tpudist.resilience.exitcodes import exit_history
 
         report["supervisor_exit_history"] = exit_history()
+        # the sink's stable run id, appended after existing keys (the same
+        # append-only discipline as the JSONL rows) so tracelens can match
+        # this report to its telemetry segments without filename heuristics
+        report["run_id"] = getattr(self.sink, "run_id", None)
         report = _strict_json(report)
         self.report_path.write_text(json.dumps(report, indent=1))
         return report

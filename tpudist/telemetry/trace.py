@@ -1,0 +1,389 @@
+"""The span layer: structured timeline rows riding :class:`TelemetrySink`,
+plus the live Prometheus exporter — the two observability surfaces PR 19
+adds on top of the existing per-rank JSONL streams (docs/OBSERVABILITY.md
+§8).
+
+Everything the subsystem already measures is an *aggregate* — percentile
+rows, breakdown averages, heartbeat intervals. A span row is the same
+measurement kept *attributed*: one row per interval (or event) with a
+start, a duration, and the identity of the thing that spent the time, so
+``tools/tracelens.py`` can stitch the per-rank streams into a Chrome/
+Perfetto timeline and a per-request latency decomposition.
+
+One row schema for every span (kind ``span``, docs/OBSERVABILITY.md §8)::
+
+    {"v": 1, "t": <wall>, "kind": "span", "rank": R, ["step": S,]
+     "name": ..., "cat": "train"|"serve", "ph": "X"|"i",
+     "t0": <span-clock start>, "dur_s": <seconds>, <tags...>}
+
+``ph`` follows the Chrome trace-event phases: ``"X"`` is a complete span,
+``"i"`` an instant event (``dur_s`` 0). ``t0``/``dur_s`` are on the
+emitter's *span clock* — ``time.monotonic`` for train spans (the heartbeat
+``mono`` domain) and the :class:`~tpudist.serve.stats.ServeStats` clock
+(``time.perf_counter``) for serve spans. Span clocks are never wall time;
+the row's own ``t`` (written at span close) is the wall anchor tracelens
+uses to place each clock domain on a shared timeline.
+
+Span values are NOT rounded: the serve tracer reuses the exact clock
+readings :class:`ServeStats` sampled, so TTFT/TPOT derived from the spans
+are bit-equal to the SLO samples (the parity test pins this), and a
+request's phase spans telescope exactly — ``queued + prefill + decode +
+preempted == total`` to float addition error.
+
+Both features are strictly opt-in: with ``trace`` off and no
+``metrics_port``, no object here is constructed and every existing stream
+stays byte-identical (the standing telemetry contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Mapping
+
+__all__ = ["Tracer", "ServeTracer", "MetricsExporter"]
+
+
+class Tracer:
+    """Span emitter for the training loop (and any host-side code that
+    thinks in intervals): ``span`` writes a completed interval, ``instant``
+    a point event. Spans are stamped with ``process_index``/``generation``
+    so multi-rank, multi-generation streams align (the same identity pair
+    heartbeat rows carry), and ``t0`` is on ``time.monotonic`` — wall
+    clocks skew across hosts, monotonic deltas do not."""
+
+    def __init__(self, sink, *, cat: str = "train", process_index: int = 0,
+                 generation: int = 0, clock=time.monotonic):
+        self.sink = sink
+        self.cat = cat
+        self.process_index = int(process_index)
+        self.generation = int(generation)
+        self._clock = clock
+
+    def span(self, name: str, dur_s: float, *, t0: float | None = None,
+             step: int | None = None, **tags) -> dict:
+        """One completed interval. ``t0`` defaults to ``now - dur_s`` —
+        the caller measured a duration and is reporting it at close, the
+        common shape in ``fit()`` (interval_s, checkpoint save time)."""
+        if t0 is None:
+            t0 = self._clock() - dur_s
+        return self.sink.write(
+            "span", step, name=name, cat=self.cat, ph="X",
+            t0=float(t0), dur_s=float(dur_s),
+            process_index=self.process_index, generation=self.generation,
+            **tags,
+        )
+
+    def instant(self, name: str, *, step: int | None = None, **tags) -> dict:
+        """One point event (repair, reshard, anomaly, probe)."""
+        return self.sink.write(
+            "span", step, name=name, cat=self.cat, ph="i",
+            t0=float(self._clock()), dur_s=0.0,
+            process_index=self.process_index, generation=self.generation,
+            **tags,
+        )
+
+
+class _Req:
+    """Per-request span state: the open phase boundaries and the tag
+    accumulators the terminal ``request`` span reports."""
+
+    __slots__ = (
+        "lane", "t_submit", "t_admit", "t_first", "t_preempt", "seg_t0",
+        "decode_s", "preempt_s", "slot", "preempts", "prefix_hit",
+        "prefix_lookup", "spec_drafted", "spec_accepted",
+    )
+
+    def __init__(self, lane: int, t_submit: float):
+        self.lane = lane
+        self.t_submit = t_submit
+        self.t_admit: float | None = None
+        self.t_first: float | None = None
+        self.t_preempt: float | None = None
+        self.seg_t0: float | None = None  # open decode segment's start
+        self.decode_s = 0.0
+        self.preempt_s = 0.0
+        self.slot: int | None = None
+        self.preempts = 0
+        self.prefix_hit: int | None = None
+        self.prefix_lookup: int | None = None
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+
+
+class ServeTracer:
+    """Per-request lifecycle spans for :class:`tpudist.serve.ServeEngine`.
+
+    The engine drives one hook per scheduler transition, passing the EXACT
+    clock reading its :class:`ServeStats` call returned — the tracer never
+    reads the clock for a phase boundary itself, so span-derived TTFT/TPOT
+    reconcile bit-equal with the SLO samples.
+
+    A request's phases telescope over its lifetime::
+
+        queued    submit → first admission (prefill dispatch)
+        prefill   first admission → first token
+        decode    first token → retire, minus the preempted gaps
+        preempted each eviction → its re-admission (the queue wait the
+                  preemption cost; the replay prefill compute lands in
+                  the decode segment that follows — it produces tokens)
+
+    so ``queued + prefill + decode + preempted == retire - submit``
+    exactly. Each closed phase is a ``span`` row; retire additionally
+    emits the terminal ``request`` span carrying the full decomposition
+    plus the request's identity tags (lane, slot, prefix-cache outcome,
+    speculative counts, preempt count)."""
+
+    def __init__(self, sink, *, rank: int = 0):
+        self.sink = sink
+        self.rank = rank
+        self._req: dict[int, _Req] = {}
+
+    # -- emission ---------------------------------------------------------
+
+    def _span(self, name: str, t0: float, t1: float, *, step=None, **tags):
+        self.sink.write(
+            "span", step, name=name, cat="serve", ph="X",
+            t0=float(t0), dur_s=float(t1 - t0), **tags,
+        )
+
+    def _instant(self, name: str, t: float, *, step=None, **tags):
+        self.sink.write(
+            "span", step, name=name, cat="serve", ph="i",
+            t0=float(t), dur_s=0.0, **tags,
+        )
+
+    # -- request lifecycle (engine-driven) --------------------------------
+
+    def on_submit(self, rid: int, t: float, *, lane: int = 0) -> None:
+        self._req[rid] = _Req(lane, t)
+
+    def on_admit(self, rid: int, t: float, *,
+                 pool_occupancy: float | None = None) -> None:
+        """First admission: the queued phase closes, prefill begins."""
+        st = self._req.get(rid)
+        if st is None or st.t_admit is not None:
+            return
+        st.t_admit = t
+        self._span("queued", st.t_submit, t, rid=rid, lane=st.lane,
+                   pool_occupancy=pool_occupancy)
+
+    def on_first_token(self, rid: int, t: float, *,
+                       slot: int | None = None,
+                       prefix_hit: int | None = None,
+                       prefix_lookup: int | None = None) -> None:
+        """Prefill produced the first token; the decode phase opens."""
+        st = self._req.get(rid)
+        if st is None or st.t_first is not None:
+            return
+        st.t_first = t
+        st.slot = slot
+        st.prefix_hit = prefix_hit
+        st.prefix_lookup = prefix_lookup
+        st.seg_t0 = t
+        self._span("prefill", st.t_admit if st.t_admit is not None else t, t,
+                   rid=rid, slot=slot, prefix_hit_blocks=prefix_hit,
+                   prefix_lookup_blocks=prefix_lookup)
+
+    def on_preempt(self, rid: int, t: float, *,
+                   pool_occupancy: float | None = None) -> None:
+        """Eviction back to the queue: the open decode segment closes,
+        the preempted phase opens."""
+        st = self._req.get(rid)
+        if st is None:
+            return
+        if st.seg_t0 is not None:
+            st.decode_s += t - st.seg_t0
+            self._span("decode", st.seg_t0, t, rid=rid, slot=st.slot)
+            st.seg_t0 = None
+        st.t_preempt = t
+        st.preempts += 1
+        self._instant("preempt", t, rid=rid, slot=st.slot,
+                      pool_occupancy=pool_occupancy)
+        st.slot = None
+
+    def on_resume(self, rid: int, t: float, *, slot: int | None = None,
+                  pool_occupancy: float | None = None) -> None:
+        """Re-admission of a preempted request: the preempted phase
+        closes, decode resumes (the replay prefill runs inside the new
+        decode segment — it is re-producing the request's progress)."""
+        st = self._req.get(rid)
+        if st is None or st.t_preempt is None:
+            return
+        st.preempt_s += t - st.t_preempt
+        self._span("preempted", st.t_preempt, t, rid=rid,
+                   pool_occupancy=pool_occupancy)
+        st.t_preempt = None
+        st.seg_t0 = t
+        st.slot = slot
+
+    def set_slot(self, rid: int, slot: int) -> None:
+        """The pool assigned (or reassigned) the request's slot — recorded
+        after the first-token hook, which fires before insertion."""
+        st = self._req.get(rid)
+        if st is not None:
+            st.slot = slot
+
+    def on_spec(self, rid: int, drafted: int, accepted: int) -> None:
+        """One verify sweep's outcome for THIS request (the per-request
+        split of ``ServeStats.on_spec``'s batch totals)."""
+        st = self._req.get(rid)
+        if st is not None:
+            st.spec_drafted += int(drafted)
+            st.spec_accepted += int(accepted)
+
+    def on_done(self, rid: int, t: float, n_tokens: int, *,
+                pool_occupancy: float | None = None) -> None:
+        """Retire: close the open decode segment and emit the terminal
+        ``request`` span with the exact phase decomposition."""
+        st = self._req.pop(rid, None)
+        if st is None:
+            return
+        if st.seg_t0 is not None:
+            st.decode_s += t - st.seg_t0
+            self._span("decode", st.seg_t0, t, rid=rid, slot=st.slot,
+                       tokens=n_tokens)
+        queued_s = (
+            (st.t_admit - st.t_submit) if st.t_admit is not None else 0.0
+        )
+        prefill_s = (
+            (st.t_first - st.t_admit)
+            if (st.t_first is not None and st.t_admit is not None) else 0.0
+        )
+        ttft_s = (
+            (st.t_first - st.t_submit) if st.t_first is not None else None
+        )
+        tpot_s = (
+            (t - st.t_first) / (n_tokens - 1)
+            if (st.t_first is not None and n_tokens > 1) else None
+        )
+        self._span(
+            "request", st.t_submit, t,
+            rid=rid, lane=st.lane, slot=st.slot, tokens=n_tokens,
+            queued_s=queued_s, prefill_s=prefill_s,
+            decode_s=st.decode_s, preempt_s=st.preempt_s,
+            ttft_s=ttft_s, tpot_s=tpot_s, preempts=st.preempts,
+            prefix_hit_blocks=st.prefix_hit,
+            prefix_lookup_blocks=st.prefix_lookup,
+            spec_drafted=st.spec_drafted, spec_accepted=st.spec_accepted,
+            pool_occupancy=pool_occupancy,
+        )
+
+    # -- scheduler ticks --------------------------------------------------
+
+    def on_tick(self, step: int, t0: float, t1: float, *, active: int,
+                queue_depth: int, emitted: int) -> None:
+        """One scheduler tick (admit + dispatch + process): the decode
+        timeline's backbone — token counts per tick, batch occupancy."""
+        self._span("tick", t0, t1, step=step, active=active,
+                   queue_depth=queue_depth, tokens=emitted)
+
+
+def _metric_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    return ("_" + s) if s[:1].isdigit() else s
+
+
+class MetricsExporter:
+    """Opt-in live scrape surface: a stdlib ``ThreadingHTTPServer`` on a
+    daemon thread serving Prometheus text exposition at ``/metrics``.
+
+    Two sources, both host-side only (never a device sync):
+
+    - **pushed gauges** — ``set(step=..., mfu=...)``; the training loop
+      pushes the scalars it already fetched for its telemetry rows.
+    - **pull collectors** — ``add_collector(fn)``; ``fn()`` runs AT SCRAPE
+      TIME and returns a mapping (the serving engine registers a
+      ``ServeStats.snapshot()`` reader, so request traffic pays zero
+      per-token cost for the endpoint).
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is
+    ``self.port``. ``None`` values are skipped (a metric with no sample
+    yet is absent, not 0 — absence is what alerting rules can see).
+    Metrics are namespaced ``tpudist_``; names ending ``_total`` are typed
+    ``counter``, everything else ``gauge``."""
+
+    def __init__(self, port: int = 0, *, host: str = "0.0.0.0",
+                 namespace: str = "tpudist"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._gauges: dict[str, float] = {}
+        self._collectors: list[Callable[[], Mapping]] = []
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server's contract
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = exporter.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tpudist-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def set(self, **gauges) -> None:
+        """Merge pushed gauge values (``None`` clears a key)."""
+        with self._lock:
+            for k, v in gauges.items():
+                if v is None:
+                    self._gauges.pop(k, None)
+                else:
+                    self._gauges[k] = v
+
+    def add_collector(self, fn: Callable[[], Mapping]) -> None:
+        """Register a scrape-time reader; later collectors win key ties."""
+        self._collectors.append(fn)
+
+    def render(self) -> str:
+        with self._lock:
+            merged: dict[str, float] = dict(self._gauges)
+        for fn in list(self._collectors):
+            try:
+                merged.update({
+                    k: v for k, v in dict(fn()).items() if v is not None
+                })
+            except Exception:
+                continue  # a scrape must never take the server down
+        lines = []
+        for key in sorted(merged):
+            v = merged[key]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            name = f"{self.namespace}_{_metric_name(key)}"
+            kind = "counter" if name.endswith("_total") else "gauge"
+            lines.append(f"# HELP {name} tpudist live metric: {key}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {float(v):g}")
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

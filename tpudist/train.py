@@ -864,6 +864,7 @@ def fit(
     chaos=None,
     init_params=None,
     init_input=None,
+    metrics_port: int | None = None,
 ) -> tuple[TrainState, list[float]]:
     """The reference's whole training program (/root/reference/main.py:86-117)
     as a function: epochs × batches, per-epoch sampler re-shuffle, windowed
@@ -1582,6 +1583,14 @@ def fit(
             )
             if tel is not None:
                 tel.goodput = gp
+                if metrics_port is not None and global_rank == 0:
+                    # opt-in live scrape endpoint (rank 0 only — the rank
+                    # that owns the report): host-side counters the loop
+                    # already computes, no extra device syncs. Closed by
+                    # tel.shutdown() in the finally below.
+                    from tpudist.telemetry.trace import MetricsExporter
+
+                    tel.exporter = MetricsExporter(metrics_port)
                 if repair_ctl is not None:
                     # detector → event-bus → repair controller: sentry and
                     # divergence verdicts become triggers; the report's
@@ -1883,6 +1892,12 @@ def fit(
                                 gp.add(
                                     "checkpoint_s",
                                     time.perf_counter() - t_save,
+                                )
+                            if tel is not None and tel.tracer is not None:
+                                tel.tracer.span(
+                                    "checkpoint",
+                                    time.perf_counter() - t_save,
+                                    step=global_step,
                                 )
                             last_save_t = time.monotonic()
                         if gp is not None:
