@@ -1,0 +1,542 @@
+"""Program anatomy (docs/OBSERVABILITY.md §9): XLA cost/memory
+introspection normalized into telemetry rows, the FLOPs-honesty
+cross-check of every model family's analytic counter against XLA's own
+count, the in-run step-time regression sentinel, and the satellite
+surfaces — the three-column HBM budget, the per-interval live peak on the
+HBM row, and the serve engine's program rows."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpudist import mesh as mesh_lib
+from tpudist.telemetry import Telemetry, TelemetryConfig, TelemetrySink
+from tpudist.telemetry import anatomy
+from tpudist.train import create_train_state, lm_loss, make_train_step
+
+
+def _rows(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+# -- cost/memory normalization (no device work) ------------------------------
+
+
+class _FakeCompiled:
+    def __init__(self, cost=None, mem=None, raises=False):
+        self._cost, self._mem, self._raises = cost, mem, raises
+
+    def cost_analysis(self):
+        if self._raises:
+            raise RuntimeError("backend says no")
+        return self._cost
+
+    def memory_analysis(self):
+        if self._raises:
+            raise RuntimeError("backend says no")
+        return self._mem
+
+
+class _FakeMemStats:
+    argument_size_in_bytes = 1000
+    output_size_in_bytes = 200
+    temp_size_in_bytes = 5000
+    alias_size_in_bytes = 150
+    generated_code_size_in_bytes = 50
+
+
+def test_program_costs_accepts_dict_and_list_of_dict():
+    cost = {"flops": 10.0, "bytes accessed": 40.0, "transcendentals": 2.0}
+    want = {"flops": 10.0, "bytes_accessed": 40.0, "transcendentals": 2.0}
+    assert anatomy.program_costs(_FakeCompiled(cost=cost)) == want
+    assert anatomy.program_costs(_FakeCompiled(cost=[cost])) == want
+
+
+def test_program_costs_fail_soft():
+    # no flops key, raising backend, empty list: all None, never a throw
+    assert anatomy.program_costs(
+        _FakeCompiled(cost={"bytes accessed": 1.0})) is None
+    assert anatomy.program_costs(_FakeCompiled(raises=True)) is None
+    assert anatomy.program_costs(_FakeCompiled(cost=[])) is None
+
+
+def test_program_memory_peak_is_resident_sum_minus_alias():
+    out = anatomy.program_memory(_FakeCompiled(mem=_FakeMemStats()))
+    assert out["argument_bytes"] == 1000 and out["temp_bytes"] == 5000
+    # args + out + temp + code - alias
+    assert out["peak_bytes"] == 1000 + 200 + 5000 + 50 - 150
+    assert anatomy.program_memory(_FakeCompiled(mem=None)) is None
+    assert anatomy.program_memory(_FakeCompiled(raises=True)) is None
+
+
+def test_analyze_program_scales_scan_counted_flops_by_grad_accum():
+    cost = {"flops": 100.0, "bytes accessed": 400.0}
+    info = anatomy.analyze_program(
+        "p", compiled=_FakeCompiled(cost=cost, mem=_FakeMemStats()),
+        grad_accum=4,
+    )
+    # HLO counts the scan body ONCE; the row carries both the raw and the
+    # per-step-scaled numbers so it stays auditable
+    assert info["flops"] == 100.0 and info["flops_scaled"] == 400.0
+    assert info["bytes_accessed"] == 1600.0
+    assert info["aot"] is True and info["peak_bytes"] == 6100
+    # lowered-only fallback: costs, no memory, aot False
+    low = anatomy.analyze_program("p", lowered=_FakeCompiled(cost=cost))
+    assert low["aot"] is False and "peak_bytes" not in low
+    assert anatomy.analyze_program("p") is None
+
+
+def test_flops_drift_sign_and_fail_soft():
+    assert anatomy.flops_drift(100.0, 110.0) == pytest.approx(0.10)
+    assert anatomy.flops_drift(100.0, 90.0) == pytest.approx(-0.10)
+    assert anatomy.flops_drift(100.0, None) is None
+    assert anatomy.flops_drift(0.0, 90.0) is None
+
+
+# -- the regression sentinel -------------------------------------------------
+
+
+def test_detector_fires_once_on_sustained_slowdown():
+    det = anatomy.StepTimeRegressionDetector(
+        warmup=2, baseline_steps=4, window=4, threshold=0.25, patience=3)
+    verdicts = []
+    for dt in [9.0, 9.0] + [0.10] * 4 + [0.20] * 10:
+        verdicts.append(det.observe(dt))
+    fired = [v for v in verdicts if v is not None]
+    assert len(fired) == 1  # one-shot
+    v = fired[0]
+    assert det.baseline == pytest.approx(0.10)
+    assert v["rolling_median_s"] == pytest.approx(0.20)
+    assert v["slowdown_pct"] == pytest.approx(100.0)
+    assert v["window"] == 4 and v["threshold"] == 0.25
+    # the 9.0s warmup intervals (compile) never polluted the baseline
+    assert det.observe(0.5) is None  # fired stays latched
+
+
+def test_detector_ignores_single_spikes():
+    det = anatomy.StepTimeRegressionDetector(
+        warmup=0, baseline_steps=4, window=5, threshold=0.25, patience=3)
+    intervals = [0.10] * 4 + [0.10, 0.10, 2.0, 0.10, 0.10] * 6
+    assert all(det.observe(dt) is None for dt in intervals)
+    assert not det.fired  # a GC pause is not a regression
+
+
+def test_detector_requires_consecutive_exceedances():
+    det = anatomy.StepTimeRegressionDetector(
+        warmup=0, baseline_steps=2, window=2, threshold=0.6, patience=3)
+    # two slow medians, then recovery, resets the patience counter
+    seq = [0.1, 0.1, 0.2, 0.2, 0.1, 0.1, 0.2, 0.2, 0.1, 0.1]
+    assert all(det.observe(dt) is None for dt in seq)
+
+
+# -- FLOPs honesty: XLA's count vs every family's analytic counter -----------
+#
+# Lowering only (no compile): `Lowered.cost_analysis()` is enough for
+# FLOPs. Tolerances are pinned from measured drift on these geometries
+# (gpt2 -4.2%, llama -2.8%, t5 -3.7%, bert -9.3%, vit -11.6%, moe at
+# capacity_factor=1.0 -10.8%/-8.1%): XLA counts what the counters
+# deliberately exclude (softmax/norm FLOPs, the classifier head), which
+# shrinks toward zero at production geometry (the 124M check below and
+# the bench anatomy leg pin 5%). A STALE counter — a model edit that
+# doubles the math — blows any of these bounds.
+
+
+def _family(name):
+    rng = np.random.Generator(np.random.PCG64(0))
+    toks = rng.integers(0, 250, (8, 32)).astype(np.int32)
+    z = jnp.zeros((1, 32), jnp.int32)
+    lm = dict(loss_fn=lm_loss, input_key="tokens", label_key="tokens")
+    if name == "gpt2":
+        from tpudist.models.gpt2 import GPT2
+
+        model = GPT2(vocab_size=256, max_seq_len=32, hidden_dim=64,
+                     depth=2, num_heads=4)
+        return model, {"tokens": toks}, z, lm, 0.10
+    if name == "llama":
+        from tpudist.models.llama import Llama
+
+        model = Llama(vocab_size=256, max_seq_len=32, hidden_dim=64,
+                      depth=2, num_heads=4)
+        return model, {"tokens": toks}, z, lm, 0.10
+    if name == "gpt2_moe":
+        from tpudist.models.gpt2 import GPT2
+
+        # capacity_factor=1.0: the dispatch computes exactly the active
+        # FLOPs the counter models (higher factors add capacity padding
+        # the counter rightly excludes — that's dispatch slack, not work)
+        model = GPT2(vocab_size=256, max_seq_len=32, hidden_dim=64,
+                     depth=2, num_heads=4, num_experts=4, moe_every=1,
+                     capacity_factor=1.0)
+        return model, {"tokens": toks}, z, lm, 0.18
+    if name == "llama_moe":
+        from tpudist.models.llama import Llama
+
+        model = Llama(vocab_size=256, max_seq_len=32, hidden_dim=64,
+                      depth=2, num_heads=4, num_experts=4, moe_every=1,
+                      capacity_factor=1.0)
+        return model, {"tokens": toks}, z, lm, 0.15
+    if name == "bert":
+        from tpudist.models.bert import Bert, mlm_forward, mlm_transform
+
+        model = Bert(vocab_size=97, max_seq_len=32, hidden_dim=64,
+                     depth=2, num_heads=4)
+        batch = mlm_transform(vocab_size=97, mask_id=3, seed=1)(
+            {"tokens": rng.integers(5, 69, (8, 16)).astype(np.int32)})
+        kw = dict(input_key="tokens", label_key="targets",
+                  forward_loss=mlm_forward(model))
+        return model, batch, jnp.zeros((1, 16), jnp.int32), kw, 0.15
+    if name == "t5":
+        from tpudist.models.t5 import (
+            T5, seq2seq_forward, span_corrupt_transform,
+        )
+
+        model = T5(vocab_size=64, hidden_dim=64, ffn_dim=128, enc_depth=2,
+                   dec_depth=2, num_heads=4)
+        batch = span_corrupt_transform(64, seed=5)(
+            {"tokens": np.tile((np.arange(32) % 37 + 1).astype(np.int32),
+                               (8, 1))})
+        init = (jnp.asarray(batch["enc_tokens"][:1]),
+                jnp.asarray(batch["dec_tokens"][:1]))
+        kw = dict(input_key="enc_tokens", label_key="targets",
+                  forward_loss=seq2seq_forward(model))
+        return model, batch, init, kw, 0.10
+    if name == "vit":
+        from tpudist.data.cifar import synthetic_cifar, to_tensor
+        from tpudist.models.vit import ViT
+
+        # mlp_dim must be 4*hidden for the model to advertise the counter
+        model = ViT(num_classes=10, patch_size=8, hidden_dim=64, depth=2,
+                    num_heads=4, mlp_dim=256)
+        batch = to_tensor(synthetic_cifar(n=8, num_classes=10))
+        return (model, batch, jnp.zeros((1, 32, 32, 3)),
+                dict(input_key="image"), 0.18)
+    raise AssertionError(name)
+
+
+@pytest.mark.parametrize(
+    "family",
+    ["gpt2", "llama", "gpt2_moe", "llama_moe", "bert", "t5", "vit"],
+)
+def test_flops_honesty_per_family(family):
+    model, batch, init_x, step_kw, tol = _family(family)
+    mesh = mesh_lib.create_mesh()
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, 0, init_x, tx, mesh)
+    step = make_train_step(model, tx, mesh, **step_kw)
+    staged = step.stage(batch)
+    info = anatomy.analyze_train_step(
+        step, state, staged, model=model,
+        input_key=step_kw.get("input_key", "image"), grad_accum=1,
+    )
+    assert info is not None and info["flops"] > 0
+    assert info["aot"] is False  # jit path: lowered, never compiled
+    assert info["bytes_accessed"] > 0
+    assert info["analytic_flops"] > 0
+    assert abs(info["flops_drift"]) < tol, (
+        f"{family} analytic counter drifted {info['flops_drift']:+.1%} "
+        f"from XLA's count — a stale counter in telemetry/flops.py")
+
+
+@pytest.mark.slow
+def test_flops_honesty_gpt2_124m_within_5pct():
+    """The acceptance bound: at production geometry (GPT-2 124M) the
+    analytic counter and XLA's count agree within 5% — the tiny-geometry
+    drift above is the excluded softmax/norm terms, which vanish here."""
+    from tpudist.models.gpt2 import GPT2
+
+    mesh = mesh_lib.create_mesh()
+    model = GPT2()  # 124M defaults: vocab 50257, hidden 768, depth 12
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 64), jnp.int32), tx, mesh)
+    step = make_train_step(model, tx, mesh, loss_fn=lm_loss,
+                           input_key="tokens", label_key="tokens")
+    rng = np.random.Generator(np.random.PCG64(0))
+    staged = step.stage(
+        {"tokens": rng.integers(0, 50257, (8, 1024)).astype(np.int32)})
+    info = anatomy.analyze_train_step(step, state, staged, model=model,
+                                      grad_accum=1)
+    assert info is not None
+    assert abs(info["flops_drift"]) < 0.05, info["flops_drift"]
+
+
+def test_grad_accum_scaling_matches_flat_batch_count():
+    """flops_scaled at grad_accum=G equals (within float noise) the flat
+    batch's count: the scan body really is counted once."""
+    from tpudist.models.gpt2 import GPT2
+
+    mesh = mesh_lib.create_mesh()
+    model = GPT2(vocab_size=256, max_seq_len=32, hidden_dim=64, depth=1,
+                 num_heads=4)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        model, 0, jnp.zeros((1, 32), jnp.int32), tx, mesh)
+    rng = np.random.Generator(np.random.PCG64(0))
+    batch = {"tokens": rng.integers(0, 250, (32, 32)).astype(np.int32)}
+    infos = {}
+    for g in (1, 2):
+        step = make_train_step(model, tx, mesh, loss_fn=lm_loss,
+                               input_key="tokens", label_key="tokens",
+                               grad_accum=g)
+        infos[g] = anatomy.analyze_train_step(
+            step, state, step.stage(batch), model=model, grad_accum=g)
+    assert infos[2]["flops"] == pytest.approx(infos[1]["flops"] / 2,
+                                              rel=0.02)
+    assert infos[2]["flops_scaled"] == pytest.approx(
+        infos[1]["flops_scaled"], rel=0.02)
+
+
+# -- telemetry wiring --------------------------------------------------------
+
+
+def test_set_anatomy_writes_row_and_stale_warning(tmp_path):
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    tel = Telemetry(TelemetryConfig(anatomy=True, anatomy_tolerance=0.05),
+                    sink, rank=0, world_size=1, log_every=1, n_chips=1)
+    tel.set_anatomy({"program": "train_step", "flops": 1e9,
+                     "flops_scaled": 1e9, "grad_accum": 1, "aot": False,
+                     "analytic_flops": 1.2e9, "flops_drift": 0.2,
+                     "flops_counter": "gpt2"})
+    tel.set_anatomy(None)  # unavailable: writes nothing, never throws
+    sink.close()
+    rows = _rows(tmp_path / "t.jsonl")
+    kinds = [r["kind"] for r in rows]
+    assert kinds.count("anatomy") == 1
+    warn = next(r for r in rows if r["kind"] == "warning")
+    assert warn["tag"] == "stale_flops_counter"
+    assert warn["flops_counter"] == "gpt2"
+    assert warn["drift"] == 0.2 and warn["tolerance"] == 0.05
+
+
+def test_set_anatomy_within_tolerance_no_warning(tmp_path):
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    tel = Telemetry(TelemetryConfig(anatomy=True), sink, rank=0,
+                    world_size=1, log_every=1, n_chips=1)
+    tel.set_anatomy({"program": "train_step", "flops": 1e9,
+                     "flops_scaled": 1e9, "grad_accum": 1, "aot": False,
+                     "analytic_flops": 0.96e9, "flops_drift": -0.04,
+                     "flops_counter": "gpt2"})
+    sink.close()
+    kinds = {r["kind"] for r in _rows(tmp_path / "t.jsonl")}
+    assert "anatomy" in kinds and "warning" not in kinds
+
+
+def test_on_step_emits_one_shot_perf_regression_row(tmp_path):
+    cfg = TelemetryConfig(regression_detect=True, regression_window=4,
+                          regression_threshold=0.25)
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    tel = Telemetry(cfg, sink, rank=0, world_size=1, log_every=100,
+                    n_chips=1)
+    g = 0
+    # warmup 2 + baseline 8 at 10ms, then a sustained 4x slowdown
+    for dt in [0.01] * 10 + [0.04] * 12:
+        g += 1
+        tel.on_step(g, {"loss": 1.0}, epoch=0, interval_s=dt,
+                    data_wait_s=0.0)
+    tel.shutdown()
+    rows = [r for r in _rows(tmp_path / "t.jsonl")
+            if r["kind"] == "perf_regression"]
+    assert len(rows) == 1  # one-shot, like the other sentinel rows
+    r = rows[0]
+    assert r["baseline_s"] == pytest.approx(0.01)
+    assert r["slowdown_pct"] == pytest.approx(300.0, abs=5.0)
+    assert r["window"] == 4 and r["step"] > 10
+
+
+def test_regression_detector_off_by_default(tmp_path):
+    sink = TelemetrySink(tmp_path / "t.jsonl")
+    tel = Telemetry(TelemetryConfig(), sink, rank=0, world_size=1,
+                    log_every=100, n_chips=1)
+    assert tel.regression is None
+    for g in range(1, 25):
+        tel.on_step(g, {"loss": 1.0}, epoch=0,
+                    interval_s=0.01 if g < 12 else 0.08, data_wait_s=0.0)
+    tel.shutdown()
+    kinds = {r["kind"] for r in _rows(tmp_path / "t.jsonl")}
+    assert "perf_regression" not in kinds and "anatomy" not in kinds
+
+
+# -- fit() integration -------------------------------------------------------
+
+
+def _fit(tmp_path, cfg, *, grad_accum=1, steps_hint=None):
+    from tpudist.data.loader import DataLoader
+    from tpudist.train import fit
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    tokens = rng.integers(0, 254, (64, 16)).astype(np.int32)
+    from tpudist.models.gpt2 import GPT2
+
+    model = GPT2(vocab_size=256, max_seq_len=16, hidden_dim=32, depth=1,
+                 num_heads=2)
+    fit(model, optax.adam(1e-3), DataLoader({"tokens": tokens}, 16),
+        epochs=2, job_id="ANAT", batch_size=16, loss_fn=lm_loss,
+        input_key="tokens", label_key="tokens", log_dir=str(tmp_path),
+        telemetry=cfg, profile=False, grad_accum=grad_accum)
+    return _rows(tmp_path / "ANAT_telemetry_0.jsonl")
+
+
+def test_fit_emits_anatomy_row_with_cross_check(tmp_path):
+    rows = _fit(
+        tmp_path,
+        TelemetryConfig(anatomy=True, run_report=False), grad_accum=2)
+    anat = [r for r in rows if r["kind"] == "anatomy"]
+    assert len(anat) == 1  # one-shot, at bring-up
+    r = anat[0]
+    assert r["program"] == "train_step" and r["grad_accum"] == 2
+    # the scan body is counted once: scaled = raw * grad_accum
+    assert r["flops_scaled"] == pytest.approx(r["flops"] * 2)
+    assert r["analytic_flops"] > 0 and "flops_drift" in r
+    assert r["flops_counter"] == "gpt2"
+    assert r["activation_bytes_est"] > 0
+
+
+def test_fit_anatomy_stale_counter_warning_at_tight_tolerance(tmp_path):
+    # tolerance far under the tiny-geometry drift: the warning MUST fire
+    rows = _fit(tmp_path, TelemetryConfig(anatomy=True,
+                                          anatomy_tolerance=0.001,
+                                          run_report=False))
+    warns = [r for r in rows if r["kind"] == "warning"
+             and r.get("tag") == "stale_flops_counter"]
+    assert len(warns) == 1
+    assert warns[0]["program"] == "train_step"
+
+
+def test_fit_default_stream_has_no_anatomy_rows(tmp_path):
+    # byte-identity contract: no knob set, no new row kinds in the stream
+    rows = _fit(tmp_path, TelemetryConfig(run_report=False))
+    kinds = {r["kind"] for r in rows}
+    assert "anatomy" not in kinds and "perf_regression" not in kinds
+    assert not any(r.get("tag") == "stale_flops_counter" for r in rows
+                   if r["kind"] == "warning")
+
+
+# -- serve engine program anatomy --------------------------------------------
+
+
+def test_serve_engine_writes_program_anatomy_rows(tmp_path):
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.serve import ServeEngine
+
+    model = GPT2(vocab_size=64, max_seq_len=64, hidden_dim=32, depth=2,
+                 num_heads=4)
+    params = model.init(
+        jax.random.key(1), np.zeros((1, 8), np.int32), train=False
+    )["params"]
+    sink = TelemetrySink(tmp_path / "s.jsonl")
+    eng = ServeEngine(model, params, max_slots=2, seed=0, sink=sink,
+                      anatomy=True)
+    rng = np.random.Generator(np.random.PCG64(3))
+    eng.submit(rng.integers(0, 64, (6,)).astype(np.int32), 4)
+    eng.run()
+    eng.close()
+    sink.close()
+    rows = [r for r in _rows(tmp_path / "s.jsonl")
+            if r["kind"] == "anatomy"]
+    programs = {r["program"] for r in rows}
+    assert "serve_decode" in programs and "serve_prefill_body" in programs
+    for r in rows:
+        assert r["flops"] > 0 and r["flops_scaled"] == r["flops"]
+    dec = next(r for r in rows if r["program"] == "serve_decode")
+    assert dec["slots"] == 2 and dec["paged"] is False
+    pre = next(r for r in rows if r["program"] == "serve_prefill_body")
+    assert pre["chunk"] > 0
+    # the rows are also held on the engine for programmatic access
+    assert {r["program"] for r in eng.anatomy_info} == programs
+
+
+def test_serve_engine_anatomy_requires_sink():
+    from tpudist.models.gpt2 import GPT2
+    from tpudist.serve import ServeEngine
+
+    model = GPT2(vocab_size=64, max_seq_len=64, hidden_dim=32, depth=2,
+                 num_heads=4)
+    params = model.init(
+        jax.random.key(1), np.zeros((1, 8), np.int32), train=False
+    )["params"]
+    with pytest.raises(ValueError, match="sink"):
+        ServeEngine(model, params, max_slots=2, seed=0, anatomy=True)
+
+
+# -- the three-column HBM budget + live-peak satellites ----------------------
+
+
+def test_budget_columns_fail_soft_on_cpu():
+    from tpudist import memory
+
+    cols = memory.budget_columns({"per_chip_total_bytes": 123})
+    assert cols["estimate_bytes"] == 123
+    # CPU backend: no allocator stats, no compiled program given
+    assert cols["xla_static_bytes"] is None
+    assert cols["live_peak_bytes"] is None
+    assert memory.budget_columns()["estimate_bytes"] is None
+
+
+def test_xla_memory_stats_and_budget_column_from_compiled():
+    from tpudist import memory
+
+    compiled = jax.jit(lambda x: (x * x).sum()).lower(
+        jnp.zeros((64, 64), jnp.float32)).compile()
+    xla = memory.xla_memory_stats(compiled)
+    assert xla is not None and xla["peak_bytes"] > 0
+    assert xla["argument_bytes"] >= 64 * 64 * 4
+    cols = memory.budget_columns({"per_chip_total_bytes": 7},
+                                 compiled=compiled)
+    assert cols["xla_static_bytes"] == xla["peak_bytes"]
+
+
+def _budget_report():
+    gb = 1024**3
+    return {
+        "params_bytes": gb, "opt_state_bytes_per_chip": 2 * gb,
+        "opt_state_bytes_global": 2 * gb, "grad_bytes": gb,
+        "activation_bytes_est": gb, "remat_policy": "none",
+        "workspace_bytes_est": gb // 2, "per_chip_total_bytes": 5 * gb,
+        "hbm_budget_bytes": 16 * gb, "fits": True, "bytes_per_param": 16,
+        "world_size": 1,
+    }
+
+
+def test_format_budget_appends_measured_columns_fail_soft():
+    from tpudist.memory import format_budget
+
+    base = format_budget(_budget_report())
+    # None sources (what fail-soft returns) keep the line byte-identical
+    assert format_budget(_budget_report(), xla_static_bytes=None,
+                         live_peak_bytes=None) == base
+    both = format_budget(_budget_report(),
+                         xla_static_bytes=6 * 1024**3,
+                         live_peak_bytes=int(5.5 * 1024**3))
+    assert both.startswith(base)
+    assert "| xla-static 6.00 GB" in both
+    assert "| live-peak 5.50 GB" in both
+
+
+def test_log_memory_appends_interval_peak_after_existing_fields(tmp_path):
+    from tpudist.metrics import MetricsLogger
+
+    sink = TelemetrySink(tmp_path / "m.jsonl")
+    logger = MetricsLogger("MEM", 16, 0, 1, log_dir=tmp_path)
+    logger.attach_sink(sink)
+    logger.log_memory({"bytes_in_use": 10, "peak_bytes_in_use": 50})
+    logger.log_memory({"bytes_in_use": 10, "peak_bytes_in_use": 50},
+                      peak_bytes_in_use=99)
+    logger.finish()
+    sink.close()
+    hbm = [l for l in logger.file_name.read_text().splitlines()
+           if l.startswith("HBM\t")]
+    assert len(hbm) == 2
+    # no kwarg: the raw allocator fields, byte-identical to the old row
+    assert json.loads(hbm[0].split("\t", 1)[1])["peak_bytes_in_use"] == 50
+    # kwarg: the per-interval peak REPLACES the lifetime high-water mark
+    assert json.loads(hbm[1].split("\t", 1)[1])["peak_bytes_in_use"] == 99
+    mem_rows = [r for r in _rows(tmp_path / "m.jsonl")
+                if r["kind"] == "memory"]
+    assert [r["peak_bytes_in_use"] for r in mem_rows] == [50, 99]
+    # appended AFTER the existing fields in the JSONL row
+    keys = list(mem_rows[1])
+    assert keys.index("peak_bytes_in_use") > keys.index("bytes_in_use")

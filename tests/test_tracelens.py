@@ -250,3 +250,34 @@ def test_fit_plus_serve_trace_end_to_end(tmp_path, monkeypatch, capsys):
     report = capsys.readouterr().out
     assert "run_id acceptance01" in report
     assert "slowest 3 request(s)" in report
+
+
+def test_run_id_filter_splits_reused_job_dir(tmp_path, capsys):
+    """Two runs reusing one job id in one log dir: --run_id must keep
+    exactly the requested run's rows (row-level — rotation interleaves
+    runs within a segment chain, so filenames can't split them), and an
+    unknown id must exit 2 rather than emit an empty trace."""
+    path = tmp_path / "RR_telemetry_0.jsonl"
+    for rid, base in (("runA", 100.0), ("runB", 200.0)):
+        sink = TelemetrySink(path, run_id=rid)  # append mode by default
+        tr = Tracer(sink, clock=lambda: 1000.0)
+        for s in range(1, 4):
+            sink.write("heartbeat", s, epoch=0, interval_s=0.1,
+                       process_index=0, host="h", mono=base + s,
+                       generation=0)
+            tr.span("step", 0.1, t0=base + s - 0.1, step=s)
+        sink.close()
+
+    out = tmp_path / "trace.json"
+    rc = tracelens.main([str(tmp_path), "--job", "RR", "--out", str(out),
+                         "--run_id", "runB"])
+    assert rc == 0
+    capsys.readouterr()
+    events = json.loads(out.read_text())["traceEvents"]
+    x = [e for e in events if e.get("ph") == "X"]
+    assert len(x) == 3  # runA's three spans filtered out
+    # and the report header names only the surviving run
+    rc = tracelens.main([str(tmp_path), "--job", "RR", "--out", str(out),
+                        "--run_id", "nosuchrun"])
+    assert rc == 2
+    assert "no rows with run_id" in capsys.readouterr().err

@@ -1,0 +1,326 @@
+"""Perf-regression gate over bench.py records: fresh leg values vs a
+rolling per-leg baseline store, with a noise band.
+
+bench.py measures; nothing *judged*. A throughput leg could quietly lose
+8% per quarter and every run would still print green, because the
+``vs_baseline`` column in BENCH_SUMMARY.json compares against a single
+hand-pinned number that nobody updates. This tool closes the loop:
+
+- a **baseline store** (JSON file, default ``BENCH_BASELINES.json`` next
+  to the record) keeps a capped rolling history of values per leg;
+- ``check`` compares a fresh record against ``median(history)`` with a
+  noise band of ``max(--band, 3 * MAD / median)`` — legs whose run-to-run
+  scatter is naturally wide earn a proportionally wide band, quiet legs
+  get the floor — and exits **3** (the tools/marker_audit.py /
+  tools/schema_audit.py offender convention) when any leg regresses;
+- ``seed`` builds the store from recorded history (BENCH_SUMMARY.json
+  files, JSONL metric streams, and archived BENCH_r*.json round files —
+  whose ``tail`` field is truncated to the last ~2000 characters, so the
+  compact-summary line on its last line is usually *torn at the front*;
+  leg entries interior to the tail are recovered by regex salvage).
+
+Direction is inferred from the metric name: legs that measure a cost
+(``*_overhead_pct``, ``*_recovery_s``, latency/ttft, bytes-per-step)
+regress *upward*; everything else (throughput) regresses *downward*.
+Legs with fewer than ``--min-history`` recorded values pass with a note
+— a gate that fails on its first run trains people to delete it.
+
+Exit codes: 0 all legs pass, 3 regression(s), 2 usage / unreadable
+record. stdlib-only, same as tools/tracelens.py, so it runs anywhere the
+record files land.
+
+Usage::
+
+    python tools/bench_gate.py seed  --store BENCH_BASELINES.json \
+        BENCH_SUMMARY.json bench_archive/BENCH_r*.json
+    python tools/bench_gate.py check --store BENCH_BASELINES.json \
+        BENCH_SUMMARY.json [--band 0.05] [--update]
+
+Pure logic (``extract_legs`` / ``baseline_of`` / ``lower_is_better`` /
+``judge``) is import-testable without touching the filesystem; see
+tests/test_bench_gate.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+EXIT_REGRESSION = 3  # marker_audit / schema_audit offender convention
+EXIT_USAGE = 2
+
+DEFAULT_STORE = "BENCH_BASELINES.json"
+DEFAULT_BAND = 0.05   # noise-band floor (fraction of baseline)
+DEFAULT_KEEP = 20     # rolling history cap per leg
+DEFAULT_MIN_HISTORY = 3
+
+# Leg entry inside a compact summary line:  "name": {"value": 12.3,
+# Works on *torn* BENCH_r*.json tails too — entries interior to the tail
+# survive truncation even when the line's head is gone.
+_LEG_RE = re.compile(
+    r'"([A-Za-z_][A-Za-z0-9_]*)":\s*\{\s*"value":\s*'
+    r'(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)'
+)
+
+# Metric-name tokens that mean "smaller is better". Checked as whole
+# underscore-delimited tokens (plus the _s unit suffix) so that e.g.
+# "tokens_per_sec" never matches "_s".
+_COST_TOKENS = frozenset({
+    "overhead", "latency", "ttft", "recovery", "bytes", "stall",
+    "p50", "p99", "ms", "s",
+})
+_COST_HINTS = ("overhead", "recovery_s", "bytes_per_step", "latency",
+               "ttft")
+
+
+def lower_is_better(name: str) -> bool:
+    """True when the metric measures a cost (time, bytes, overhead) so a
+    regression is an *increase*. Throughput-style names default False."""
+    if any(h in name for h in _COST_HINTS):
+        return True
+    tokens = name.split("_")
+    # unit suffix: *_s / *_ms / *_pct read as durations or ratios only
+    # when the name isn't a rate ("per_sec" etc. never reach here).
+    return bool(tokens) and tokens[-1] in _COST_TOKENS and \
+        "per" not in tokens
+
+
+def extract_legs(text: str) -> dict[str, float]:
+    """``{leg: value}`` from any recorded bench artifact, newest wins.
+
+    Accepts, in one pass over the lines:
+    - a whole-file JSON summary with a ``legs`` dict (BENCH_SUMMARY.json)
+      or a BENCH_r*.json round file (legs salvaged from its ``tail``);
+    - JSONL metric lines ``{"metric": ..., "value": ...}`` (the
+      $TPUDIST_BENCH_RECORD stream);
+    - compact-summary lines with a ``legs`` dict, even torn ones —
+      falls back to regex salvage when json.loads refuses the line.
+    """
+    legs: dict[str, float] = {}
+    try:
+        whole = json.loads(text)
+    except ValueError:
+        whole = None
+    if isinstance(whole, dict):
+        if isinstance(whole.get("legs"), dict):
+            for name, ent in whole["legs"].items():
+                val = ent.get("value") if isinstance(ent, dict) else ent
+                if isinstance(val, (int, float)):
+                    legs[str(name)] = float(val)
+            return legs
+        if isinstance(whole.get("tail"), str):
+            # archived round file; the tail is truncated, salvage it
+            text = whole["tail"]
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        row = None
+        if line.startswith("{"):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                row = None
+        if isinstance(row, dict):
+            if isinstance(row.get("legs"), dict):
+                for name, ent in row["legs"].items():
+                    val = ent.get("value") if isinstance(ent, dict) \
+                        else ent
+                    if isinstance(val, (int, float)):
+                        legs[str(name)] = float(val)
+                continue
+            metric, val = row.get("metric"), row.get("value")
+            if isinstance(metric, str) and isinstance(val, (int, float)):
+                legs[metric] = float(val)
+                continue
+        if '"value"' in line:  # torn summary line: regex salvage
+            for name, num in _LEG_RE.findall(line):
+                legs[name] = float(num)
+    return legs
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def baseline_of(history: list[float],
+                band_floor: float = DEFAULT_BAND) -> tuple[float, float]:
+    """``(median, band)`` for a leg's history. The band is the larger of
+    the floor and ``3 * MAD / median`` — a robust scale estimate, so one
+    historical outlier widens the band far less than a stdev would."""
+    med = _median(history)
+    if med == 0:
+        return med, band_floor
+    mad = _median([abs(v - med) for v in history])
+    return med, max(band_floor, 3.0 * mad / abs(med))
+
+
+def judge(name: str, value: float, history: list[float],
+          band_floor: float = DEFAULT_BAND,
+          min_history: int = DEFAULT_MIN_HISTORY) -> dict:
+    """One leg's verdict: ``{leg, value, status, ...}`` where status is
+    ``pass`` / ``regression`` / ``no-history``."""
+    if len(history) < min_history:
+        return {"leg": name, "value": value, "status": "no-history",
+                "history": len(history)}
+    med, band = baseline_of(history, band_floor)
+    lower = lower_is_better(name)
+    if lower:
+        limit = med * (1.0 + band)
+        bad = value > limit
+    else:
+        limit = med * (1.0 - band)
+        bad = value < limit
+    delta = 0.0 if med == 0 else (value - med) / abs(med)
+    return {
+        "leg": name, "value": value, "baseline": med,
+        "band_pct": round(band * 100.0, 2),
+        "delta_pct": round(delta * 100.0, 2),
+        "direction": "lower-is-better" if lower else "higher-is-better",
+        "status": "regression" if bad else "pass",
+    }
+
+
+def load_store(path: Path) -> dict[str, list[float]]:
+    if not path.exists():
+        return {}
+    raw = json.loads(path.read_text(encoding="utf-8"))
+    out: dict[str, list[float]] = {}
+    for name, vals in raw.items():
+        if isinstance(vals, list):
+            out[name] = [float(v) for v in vals
+                         if isinstance(v, (int, float))]
+    return out
+
+
+def save_store(path: Path, store: dict[str, list[float]],
+               keep: int = DEFAULT_KEEP) -> None:
+    trimmed = {k: v[-keep:] for k, v in sorted(store.items())}
+    path.write_text(json.dumps(trimmed, indent=1, sort_keys=True) + "\n",
+                    encoding="utf-8")
+
+
+def _read_records(paths: list[str]) -> list[tuple[str, dict[str, float]]]:
+    out = []
+    for p in paths:
+        path = Path(p)
+        try:
+            text = path.read_text(encoding="utf-8", errors="replace")
+        except OSError as exc:
+            print(f"bench_gate: cannot read {p}: {exc}", file=sys.stderr)
+            return []
+        out.append((p, extract_legs(text)))
+    return out
+
+
+def cmd_seed(args) -> int:
+    records = _read_records(args.records)
+    if not records:
+        return EXIT_USAGE
+    store = load_store(Path(args.store))
+    added = 0
+    for name, legs in records:
+        if not legs:
+            print(f"bench_gate: no legs recovered from {name}")
+            continue
+        for leg, val in legs.items():
+            store.setdefault(leg, []).append(val)
+            added += 1
+        print(f"bench_gate: seeded {len(legs)} leg value(s) from {name}")
+    save_store(Path(args.store), store, args.keep)
+    print(f"bench_gate: store {args.store} now tracks "
+          f"{len(store)} leg(s) ({added} value(s) added)")
+    return 0
+
+
+def cmd_check(args) -> int:
+    records = _read_records(args.records)
+    if not records:
+        return EXIT_USAGE
+    fresh: dict[str, float] = {}
+    for _, legs in records:
+        fresh.update(legs)
+    if not fresh:
+        print("bench_gate: no leg values found in the given record(s)",
+              file=sys.stderr)
+        return EXIT_USAGE
+    store = load_store(Path(args.store))
+    verdicts = [judge(leg, val, store.get(leg, []), args.band,
+                      args.min_history)
+                for leg, val in sorted(fresh.items())]
+    bad = [v for v in verdicts if v["status"] == "regression"]
+    for v in verdicts:
+        if v["status"] == "no-history":
+            print(f"  {v['leg']}: {v['value']:g}  (no baseline yet, "
+                  f"{v['history']} recorded — passes)")
+        else:
+            sign = "+" if v["delta_pct"] >= 0 else ""
+            mark = "REGRESSION" if v["status"] == "regression" else "ok"
+            print(f"  {v['leg']}: {v['value']:g} vs baseline "
+                  f"{v['baseline']:g} ({sign}{v['delta_pct']}%, band "
+                  f"±{v['band_pct']}%, {v['direction']}) {mark}")
+    if args.update and not bad:
+        for leg, val in fresh.items():
+            store.setdefault(leg, []).append(val)
+        save_store(Path(args.store), store, args.keep)
+        print(f"bench_gate: store updated ({args.store})")
+    if bad:
+        print(f"bench gate FAILED: {len(bad)} leg(s) regressed beyond "
+              "the noise band")
+        return EXIT_REGRESSION
+    print(f"bench gate: {len(verdicts)} leg(s) within the noise band")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_gate.py",
+        description="rolling-baseline perf gate over bench.py records",
+    )
+    sub = ap.add_subparsers(dest="cmd")
+    common = dict(store=DEFAULT_STORE, keep=DEFAULT_KEEP)
+
+    def _shared(p):
+        p.add_argument("records", nargs="+",
+                       help="record file(s): BENCH_SUMMARY.json, JSONL "
+                            "metric stream, or archived BENCH_r*.json")
+        p.add_argument("--store", default=common["store"],
+                       help="baseline store JSON path "
+                            f"(default {DEFAULT_STORE})")
+        p.add_argument("--keep", type=int, default=common["keep"],
+                       help="rolling history cap per leg "
+                            f"(default {DEFAULT_KEEP})")
+
+    chk = sub.add_parser("check", help="gate a fresh record (exit 3 on "
+                                       "regression)")
+    _shared(chk)
+    chk.add_argument("--band", type=float, default=DEFAULT_BAND,
+                     help="noise-band floor as a fraction "
+                          f"(default {DEFAULT_BAND})")
+    chk.add_argument("--min-history", type=int,
+                     default=DEFAULT_MIN_HISTORY,
+                     help="baseline needs this many recorded values "
+                          f"(default {DEFAULT_MIN_HISTORY})")
+    chk.add_argument("--update", action="store_true",
+                     help="on pass, append the fresh values to the store")
+    seed = sub.add_parser("seed", help="build the baseline store from "
+                                       "recorded history")
+    _shared(seed)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "seed":
+        return cmd_seed(args)
+    if args.cmd == "check":
+        return cmd_check(args)
+    ap.print_help(sys.stderr)
+    return EXIT_USAGE
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

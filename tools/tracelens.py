@@ -33,6 +33,7 @@ Usage::
 
     python tools/tracelens.py LOGDIR [more files/dirs ...]
         [--job JOB]          only streams of this job id
+        [--run_id ID]        only rows of this run (multi-run directories)
         [--out trace.json]   Perfetto output path (default: trace.json)
         [--top K]            rows in the slowest-request table (default 10)
         [--no-report]        skip the text report
@@ -328,6 +329,11 @@ def main(argv=None) -> int:
                     help="JSONL files and/or log directories")
     ap.add_argument("--job", default=None,
                     help="only streams of this job id ({job}_*.jsonl)")
+    ap.add_argument("--run_id", default=None,
+                    help="only rows of this run_id — a log dir holding "
+                    "several runs' rotated segments (append-mode relaunch, "
+                    "shared dir) stitches ONE run instead of interleaving "
+                    "them; run ids are listed in the report header")
     ap.add_argument("--out", default="trace.json",
                     help="Perfetto trace output path")
     ap.add_argument("--top", default=10, type=int,
@@ -342,6 +348,15 @@ def main(argv=None) -> int:
     rows = []
     for base, segments in chains.items():
         rows.extend(read_chain(segments))
+    if args.run_id:
+        # row-level, not file-level: rotation interleaves runs within one
+        # segment chain when a job id is reused, so filenames can't split
+        # them — the per-row run_id stamp can
+        rows = [r for r in rows if r.get("run_id") == args.run_id]
+        if not rows:
+            print(f"tracelens: no rows with run_id {args.run_id}",
+                  file=sys.stderr)
+            return 2
     events = to_trace_events(rows)
     Path(args.out).write_text(
         json.dumps({"traceEvents": events, "displayTimeUnit": "ms"}),

@@ -39,6 +39,8 @@ __all__ = [
     "transformer_activation_bytes",
     "train_state_budget",
     "device_memory_stats",
+    "xla_memory_stats",
+    "budget_columns",
     "format_budget",
 ]
 
@@ -302,15 +304,56 @@ def train_state_budget(
     return out
 
 
-def format_budget(report: Mapping[str, Any]) -> str:
+def xla_memory_stats(compiled) -> dict[str, int] | None:
+    """The compiler's own static HBM breakdown of a COMPILED program
+    (``Compiled.memory_analysis()``, normalized by
+    :func:`tpudist.telemetry.anatomy.program_memory`): argument / output /
+    temp / generated-code bytes and the resident-sum ``peak_bytes``. The
+    middle column of the budget table — between the pre-compile estimate
+    and the live allocator — and fail-soft ``None`` on backends (or
+    merely-lowered objects) that don't implement memory analysis."""
+    from tpudist.telemetry.anatomy import program_memory
+
+    return program_memory(compiled)
+
+
+def budget_columns(report: Mapping[str, Any] | None = None, *,
+                   compiled=None, device=None) -> dict[str, int | None]:
+    """The three-source HBM comparison row (docs/PERF.md §10): the
+    pre-compile analytic ESTIMATE, the compiler's XLA-STATIC reservation,
+    and the LIVE allocator peak — each ``None`` where its source is
+    unavailable (no report / no compiled program / a CPU backend), never
+    a fabricated number. Estimate ≫ static usually means a stale
+    activation model; live ≫ static means fragmentation or an allocator
+    the program doesn't own alone."""
+    xla = xla_memory_stats(compiled) if compiled is not None else None
+    live = device_memory_stats(device)
+    return {
+        "estimate_bytes": (
+            None if report is None else report.get("per_chip_total_bytes")
+        ),
+        "xla_static_bytes": None if xla is None else xla.get("peak_bytes"),
+        "live_peak_bytes": (
+            None if live is None else live.get("peak_bytes_in_use")
+        ),
+    }
+
+
+def format_budget(report: Mapping[str, Any], *,
+                  xla_static_bytes: int | None = None,
+                  live_peak_bytes: int | None = None) -> str:
     """One human line per component, GB with the fits verdict — what the
-    bench leg and PERF table print."""
+    bench leg and PERF table print. ``xla_static_bytes`` /
+    ``live_peak_bytes`` (from :func:`budget_columns`) append the measured
+    columns next to the estimate when a compiled program / a reporting
+    backend is at hand; ``None`` (the default, and what fail-soft sources
+    return) leaves the line byte-identical to the estimate-only form."""
     gb = 1024**3
 
     def f(k):
         return f"{report[k] / gb:.2f}"
 
-    return (
+    line = (
         f"params {f('params_bytes')} GB + opt_state "
         f"{f('opt_state_bytes_per_chip')} GB/chip "
         f"(global {f('opt_state_bytes_global')}) + grads {f('grad_bytes')} "
@@ -320,6 +363,11 @@ def format_budget(report: Mapping[str, Any]) -> str:
         f" -> {'FITS' if report['fits'] else 'DOES NOT FIT'} "
         f"({report['bytes_per_param']} B/param, world={report['world_size']})"
     )
+    if xla_static_bytes is not None:
+        line += f" | xla-static {xla_static_bytes / gb:.2f} GB"
+    if live_peak_bytes is not None:
+        line += f" | live-peak {live_peak_bytes / gb:.2f} GB"
+    return line
 
 
 def device_memory_stats(device=None) -> dict[str, int] | None:

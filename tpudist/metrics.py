@@ -104,21 +104,33 @@ class MetricsLogger:
         if self.global_rank == 0 and idx % self.print_every == 0:
             print("Epoch: {} step: {} loss: {}".format(epoch, idx, loss_value))
 
-    def log_memory(self, stats: dict | None) -> None:
+    def log_memory(self, stats: dict | None,
+                   peak_bytes_in_use: int | None = None) -> None:
         """One ``HBM\\t{json}`` row (rank 0) with live device memory stats
         (``tpudist.memory.device_memory_stats``) — the measured side of the
         pre-compile HBM budget, written next to the throughput rows it
         explains. Footer-style like ``TrainTime`` (a tagged row, not a data
         row), so the reference's field-exact TSV contract is untouched.
-        No-op when the backend reports nothing (CPU) or off rank 0."""
+        No-op when the backend reports nothing (CPU) or off rank 0.
+
+        ``peak_bytes_in_use``, when given, is the PER-INTERVAL peak fit()
+        derives from the allocator's lifetime high-water mark — it
+        replaces the raw (monotone, spike-hiding) allocator value and is
+        appended AFTER the existing fields in the JSONL row, so transient
+        activation spikes between cadence rows stay visible. ``None``
+        keeps both streams byte-identical to the pre-feature rows."""
         if not stats or self.global_rank != 0:
             return
         import json
 
-        self._file.write("HBM\t%s\n" % json.dumps(stats, sort_keys=True))
+        fields = dict(stats)
+        if peak_bytes_in_use is not None:
+            fields.pop("peak_bytes_in_use", None)
+            fields["peak_bytes_in_use"] = int(peak_bytes_in_use)
+        self._file.write("HBM\t%s\n" % json.dumps(fields, sort_keys=True))
         self._file.flush()
         if self._sink is not None:
-            self._sink.write("memory", **stats)
+            self._sink.write("memory", **fields)
 
     def finish(self) -> float:
         train_time = time.time() - self._train_begin

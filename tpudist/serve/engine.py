@@ -520,7 +520,8 @@ class ServeEngine:
                  ttft_slo_s: float | None = None, compile_cache=None,
                  draft_model=None, draft_params=None, spec_k: int = 4,
                  mesh=None, trace: bool = False,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 anatomy: bool = False):
         self.mesh = mesh
         self.tensor_world = 1
         self._kv_sharding = None
@@ -718,6 +719,19 @@ class ServeEngine:
         self.compile_cache_info: dict | None = None
         if compile_cache is not None:
             self._setup_compile_cache(compile_cache, seed=seed)
+        # program anatomy at bring-up (docs/OBSERVABILITY.md §9): one
+        # `anatomy` row per serving program — XLA's own FLOPs/bytes for a
+        # decode tick and a prefill body chunk. The AOT executables above
+        # yield cost AND static memory for free; without a compile cache
+        # each program pays one lowering (no compile). Off (the default)
+        # runs nothing and the streams stay byte-identical.
+        self.anatomy_info: list[dict] | None = None
+        if anatomy:
+            if sink is None:
+                raise ValueError("anatomy=True needs a sink= to write to")
+            self.anatomy_info = self.program_anatomy()
+            for row in self.anatomy_info:
+                sink.write("anatomy", **row)
 
     # -- submission --------------------------------------------------------
 
@@ -1457,6 +1471,120 @@ class ServeEngine:
                 h.update(arr.tobytes())
         return h.hexdigest()[:24]
 
+    def _sds(self, x):
+        """Shape/dtype (and, on a mesh engine, COMMITTED sharding) struct
+        of one example argument: the lowered executable must see each
+        argument's real placement (replicated lanes, KV-sharded pools) or
+        first-call validation rejects the real args. Shared by the AOT
+        compile-cache lowers and program introspection."""
+        sh = getattr(x, "sharding", None)
+        if self.mesh is not None and sh is not None:
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sh)
+        return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+    def _i32(self, *shape):
+        return self._dev(jnp.zeros(shape, jnp.int32))
+
+    def _decode_example_args(self) -> list:
+        """Example argument list of ONE decode tick — exactly the shapes,
+        dtypes, and committed placements `_decode_fn` is fed every step
+        (mesh engine: each lane commits replicated via the same _dev
+        discipline the per-tick dispatch uses). One definition feeds both
+        the AOT compile-cache lower and :meth:`program_anatomy`, so the
+        cached program and the introspected one can never drift."""
+        s = self.pool.max_slots
+        i32 = self._i32
+        zeros_b = lambda: self._dev(jnp.zeros(s, bool))
+        zeros_f = lambda: self._dev(jnp.zeros(s, jnp.float32))
+        ones_f = lambda: self._dev(jnp.ones(s, jnp.float32))
+        if self.spec:
+            args = [
+                self.pool.cache, self._draft_pool.cache, i32(s), i32(s),
+                zeros_b(), i32(s), i32(s),
+            ]
+            if self.paged:
+                args.append(i32(s, self.pool.max_blocks))
+            args += [
+                zeros_b(), i32(s), zeros_f(),
+                i32(s), ones_f(), i32(s), i32(s),
+            ]
+            return args
+        args = [self.pool.cache, i32(s), i32(s), zeros_b(), i32(s)]
+        if self.paged:
+            args.append(i32(s, self.pool.max_blocks))
+        args += [
+            zeros_b(), i32(s), i32(s), zeros_f(),
+            i32(s), ones_f(), i32(s),
+        ]
+        return args
+
+    def _prefill_row_example(self, prefiller):
+        """The batch-1 KV-row example tree a prefill program is lowered
+        against: ``_cache_shapes`` is already a ShapeDtypeStruct tree (no
+        device allocation just to describe shapes); on a mesh engine it is
+        re-structed with the KV sharding the prefiller's fresh caches
+        actually carry."""
+        row_ex = prefiller._cache_shapes
+        if self._kv_sharding is not None:
+            row_ex = jax.tree_util.tree_map(
+                lambda t: jax.ShapeDtypeStruct(
+                    t.shape, t.dtype,
+                    sharding=(
+                        self._kv_sharding if len(t.shape) == 4
+                        else self._rep_sharding
+                    ),
+                ),
+                row_ex,
+            )
+        return row_ex
+
+    def program_anatomy(self) -> list[dict]:
+        """XLA's own account of the serving programs (docs/OBSERVABILITY
+        .md §9): one info dict per program — the decode tick and a prefill
+        body chunk — with XLA-counted FLOPs/bytes and, when the program
+        came through the AOT compile cache, the static HBM breakdown too
+        (a merely-lowered program yields costs only; lowering is cheap, no
+        compile). Per-program fail-soft: an un-analyzable config
+        contributes nothing rather than failing engine bring-up."""
+        from tpudist.telemetry.anatomy import analyze_program
+
+        rows: list[dict] = []
+        try:
+            exe = (self._decode_aot or {}).get("exe")
+            lowered = None
+            if exe is None:
+                lowered = self._decode_fn.lower(*jax.tree_util.tree_map(
+                    self._sds, self._decode_example_args()
+                ))
+            info = analyze_program(
+                "serve_spec_decode" if self.spec else "serve_decode",
+                compiled=exe, lowered=lowered,
+            )
+            if info is not None:
+                info["slots"] = int(self.pool.max_slots)
+                info["paged"] = self.paged
+                rows.append(info)
+        except Exception:
+            pass
+        try:
+            chunk = self.prefiller.chunk
+            exe = self.prefiller._aot.get(("body", chunk))
+            lowered = None
+            if exe is None:
+                example = (self._prefill_row_example(self.prefiller),
+                           self._i32(1, chunk))
+                lowered = self.prefiller._chunk_body.lower(
+                    *jax.tree_util.tree_map(self._sds, example)
+                )
+            info = analyze_program("serve_prefill_body", compiled=exe,
+                                   lowered=lowered)
+            if info is not None:
+                info["chunk"] = int(chunk)
+                rows.append(info)
+        except Exception:
+            pass
+        return rows
+
     def _setup_compile_cache(self, directory, *, seed: int) -> None:
         """Deploy-time program inventory through the AOT executable cache:
         the decode step plus every power-of-two prefill bucket's body/
@@ -1470,15 +1598,6 @@ class ServeEngine:
         fp = self._fingerprint(seed)
         info: dict = {"hits": 0, "misses": 0, "programs": {}, "bytes": 0}
 
-        def sds(x):
-            # mesh engine: the lowered executable must see each argument's
-            # COMMITTED sharding (replicated lanes, KV-sharded pools) or
-            # first-call validation rejects the real args
-            sh = getattr(x, "sharding", None)
-            if self.mesh is not None and sh is not None:
-                return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sh)
-            return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
-
         def fetch(name, jitted, *example):
             key = f"{fp}-{name}"
             exe = cc.load(key)
@@ -1488,7 +1607,7 @@ class ServeEngine:
                 return exe
             try:
                 exe = jitted.lower(
-                    *jax.tree_util.tree_map(sds, example)
+                    *jax.tree_util.tree_map(self._sds, example)
                 ).compile()
                 nbytes = cc.store(key, exe, {"program": name})
                 if nbytes and cc.load(key) is None:
@@ -1513,56 +1632,15 @@ class ServeEngine:
                 info["programs"][name] = f"error:{type(exc).__name__}"
                 return None
 
-        s = self.pool.max_slots
-        cache_ex = self.pool.cache
-        # mesh engine: every example lane commits replicated (same _dev
-        # discipline the per-tick dispatch uses), so the lowered argument
-        # shardings match what the engine will actually pass
-        i32 = lambda *shape: self._dev(jnp.zeros(shape, jnp.int32))
-        zeros_b = lambda: self._dev(jnp.zeros(s, bool))
-        zeros_f = lambda: self._dev(jnp.zeros(s, jnp.float32))
-        ones_f = lambda: self._dev(jnp.ones(s, jnp.float32))
-        if self.spec:
-            decode_args = [
-                cache_ex, self._draft_pool.cache, i32(s), i32(s),
-                zeros_b(), i32(s), i32(s),
-            ]
-            if self.paged:
-                decode_args.append(i32(s, self.pool.max_blocks))
-            decode_args += [
-                zeros_b(), i32(s), zeros_f(),
-                i32(s), ones_f(), i32(s), i32(s),
-            ]
-            self._decode_aot = {"exe": fetch("spec", self._decode_fn,
-                                             *decode_args)}
-        else:
-            decode_args = [
-                cache_ex, i32(s), i32(s), zeros_b(), i32(s),
-            ]
-            if self.paged:
-                decode_args.append(i32(s, self.pool.max_blocks))
-            decode_args += [
-                zeros_b(), i32(s), i32(s), zeros_f(),
-                i32(s), ones_f(), i32(s),
-            ]
-            self._decode_aot = {"exe": fetch("decode", self._decode_fn,
-                                             *decode_args)}
-        # _cache_shapes is already a ShapeDtypeStruct tree and sds() maps
+        decode_args = self._decode_example_args()
+        self._decode_aot = {"exe": fetch(
+            "spec" if self.spec else "decode", self._decode_fn, *decode_args
+        )}
+        # _cache_shapes is already a ShapeDtypeStruct tree and _sds() maps
         # it through unchanged — no device-side batch-1 cache allocation
         # just to describe shapes (mesh engine: re-struct with the KV
         # sharding the prefiller's fresh caches actually carry)
-        row_ex = self.prefiller._cache_shapes
-        if self._kv_sharding is not None:
-            row_ex = jax.tree_util.tree_map(
-                lambda t: jax.ShapeDtypeStruct(
-                    t.shape, t.dtype,
-                    sharding=(
-                        self._kv_sharding if len(t.shape) == 4
-                        else self._rep_sharding
-                    ),
-                ),
-                row_ex,
-            )
+        row_ex = self._prefill_row_example(self.prefiller)
         buckets, b = [], self.prefiller.minimum
         while b <= self.prefiller.chunk:
             buckets.append(b)
@@ -1570,13 +1648,13 @@ class ServeEngine:
         aot = {}
         for b in buckets:
             exe = fetch(f"pf{b}", self.prefiller._chunk_final,
-                        row_ex, i32(1, b))
+                        row_ex, self._i32(1, b))
             if exe is not None:
                 aot[("final", b)] = exe
         # body chunks are always exactly `chunk` long (only the final
         # chunk is partial), so one body program covers them
         exe = fetch(f"pb{self.prefiller.chunk}", self.prefiller._chunk_body,
-                    row_ex, i32(1, self.prefiller.chunk))
+                    row_ex, self._i32(1, self.prefiller.chunk))
         if exe is not None:
             aot[("body", self.prefiller.chunk)] = exe
         self.prefiller.attach_aot(aot)
@@ -1585,21 +1663,11 @@ class ServeEngine:
             # the bucketed final one — through its body program, so it
             # needs a body executable at every bucket, not just `chunk`
             dpf = self._draft_prefiller
-            d_row_ex = dpf._cache_shapes
-            if self._kv_sharding is not None:
-                d_row_ex = jax.tree_util.tree_map(
-                    lambda t: jax.ShapeDtypeStruct(
-                        t.shape, t.dtype,
-                        sharding=(
-                            self._kv_sharding if len(t.shape) == 4
-                            else self._rep_sharding
-                        ),
-                    ),
-                    d_row_ex,
-                )
+            d_row_ex = self._prefill_row_example(dpf)
             d_aot = {}
             for b in {*buckets, dpf.chunk}:
-                exe = fetch(f"dpb{b}", dpf._chunk_body, d_row_ex, i32(1, b))
+                exe = fetch(f"dpb{b}", dpf._chunk_body, d_row_ex,
+                            self._i32(1, b))
                 if exe is not None:
                     d_aot[("body", b)] = exe
             dpf.attach_aot(d_aot)

@@ -130,6 +130,18 @@ class TelemetryConfig:
     # re-emits the step breakdown, checkpoint saves, health probes, and
     # repair/reshard events as `span` rows on the same sink
     trace: bool = False
+    # program-anatomy layer (tpudist.telemetry.anatomy) — off by default.
+    # `anatomy` makes fit() introspect the compiled step at bring-up (one
+    # `anatomy` row; a stale-counter `warning` when the analytic FLOPs
+    # counter drifts from XLA's count beyond `anatomy_tolerance`).
+    # `regression_detect` arms the in-run step-time sentinel: rolling
+    # median over `regression_window` intervals vs the post-compile
+    # baseline, one-shot `perf_regression` row past `regression_threshold`
+    anatomy: bool = False
+    anatomy_tolerance: float = 0.1
+    regression_detect: bool = False
+    regression_threshold: float = 0.25
+    regression_window: int = 16
 
     def step_kwargs(self) -> dict:
         """The ``make_train_step`` knobs this config implies — the ONE
@@ -180,7 +192,11 @@ class TelemetrySink:
     ``compile_cache`` (one-time AOT executable-cache outcome:
     hit/miss/bytes/load_s), ``repair`` (one record per executed repair
     action — cause, rollback step, skipped window, action taken:
-    ``tpudist.resilience.repair``). The serving engine
+    ``tpudist.resilience.repair``), ``anatomy`` (one-shot per-program
+    compiler introspection: XLA-counted FLOPs/bytes and the static HBM
+    breakdown, cross-checked against the analytic counters —
+    ``tpudist.telemetry.anatomy``), ``perf_regression`` (the in-run
+    step-time sentinel's one-shot verdict). The serving engine
     (``tpudist.serve``) writes ``serve``/``serve_summary`` SLO rows
     through the same sink — TTFT/TPOT percentiles, slot utilization,
     and in paged mode the block-pool triple (``pool_occupancy``,
@@ -515,6 +531,17 @@ class Telemetry:
         # build_telemetry when config.trace; None keeps every span path a
         # no-op and the streams byte-identical
         self.tracer = None
+        # in-run perf-regression sentinel (tpudist.telemetry.anatomy) —
+        # None (the default) keeps on_step's path byte-identical
+        if config.regression_detect:
+            from tpudist.telemetry.anatomy import StepTimeRegressionDetector
+
+            self.regression = StepTimeRegressionDetector(
+                window=config.regression_window,
+                threshold=config.regression_threshold,
+            )
+        else:
+            self.regression = None
         # live-metrics exporter (tpudist.telemetry.trace.MetricsExporter),
         # attached by fit(metrics_port=); on_step pushes host-side gauges
         # into it — no device syncs, no extra rows
@@ -632,6 +659,35 @@ class Telemetry:
         when ``fit`` got a ``compile_cache=`` request."""
         if self.rank == 0:
             self.sink.write("compile_cache", **dict(info))
+
+    def set_anatomy(self, info: Mapping[str, Any] | None) -> None:
+        """One ``anatomy`` row per introspected program (rank 0): XLA's
+        own FLOPs/bytes count and static HBM breakdown for a compiled
+        train/serve program (:func:`tpudist.telemetry.anatomy
+        .analyze_train_step`), with the analytic cross-check fields when a
+        counter exists. When the counter's drift against XLA exceeds
+        ``config.anatomy_tolerance`` a ``stale_flops_counter`` warning row
+        follows, naming the counter — the MFU-honesty alarm. ``None``
+        (introspection unavailable) writes nothing; only written when
+        ``fit``/serve got an anatomy request, so streams stay
+        byte-identical otherwise."""
+        if info is None or self.rank != 0:
+            return
+        self.sink.write("anatomy", **dict(info))
+        drift = info.get("flops_drift")
+        if drift is not None and abs(drift) > self.config.anatomy_tolerance:
+            self.warn(
+                "stale_flops_counter",
+                program=info.get("program"),
+                flops_counter=info.get("flops_counter"),
+                xla_flops=info.get("flops_scaled"),
+                analytic_flops=info.get("analytic_flops"),
+                drift=round(drift, 4),
+                tolerance=self.config.anatomy_tolerance,
+                hint="tpudist/telemetry/flops.py's analytic counter "
+                     "disagrees with XLA's cost analysis for this program "
+                     "— the MFU rows' numerator is stale",
+            )
 
     def warn(self, tag: str, step: int | None = None, **fields) -> None:
         """A tagged one-shot ``warning`` row (same schema as the
@@ -818,6 +874,16 @@ class Telemetry:
                 # detector → event bus: the repair loop (and any other
                 # subscriber) acts on the verdict the row records
                 self._publish({"detector": "sentry", **event})
+
+        if self.regression is not None and self.rank == 0:
+            # in-run slowdown sentinel: collectives equalize interval_s
+            # fleet-wide, so one observing rank suffices — and one row
+            verdict = self.regression.observe(interval_s)
+            if verdict is not None:
+                self.sink.write("perf_regression", step, epoch=epoch,
+                                **verdict)
+                if self.tracer is not None:
+                    self.tracer.instant("perf_regression", step=step)
 
         if self.heartbeat_every and step % self.heartbeat_every == 0:
             # every process writes its own heartbeat — the cross-host

@@ -1651,6 +1651,40 @@ def fit(
                     from tpudist.comm import measure_h2d_mbps
 
                     tel.h2d_mbps = measure_h2d_mbps()
+                if tel.config.anatomy:
+                    # program anatomy at bring-up (docs/OBSERVABILITY.md
+                    # §9): ask XLA what it actually compiled — FLOPs,
+                    # bytes, static HBM — and cross-check the analytic
+                    # MFU counter against it. The AOT path reuses the
+                    # compile-cache executable for free; the jit path
+                    # pays one lowering (no compile). Entirely fail-soft:
+                    # introspection must never take a training run down.
+                    try:
+                        from tpudist import compile_cache as cc_mod
+                        from tpudist.telemetry import anatomy as anat_mod
+
+                        anat_staged = cc_staged
+                        if anat_staged is None:
+                            anat_staged = cc_mod.staged_example(
+                                step, train_loader
+                            )
+                        if anat_staged is None:
+                            tel.warn(
+                                "anatomy_unavailable",
+                                reason="loader cannot be probed into a "
+                                "shaped batch — no program to lower",
+                            )
+                        else:
+                            tel.set_anatomy(anat_mod.analyze_train_step(
+                                step, state, anat_staged, model=model,
+                                input_key=input_key,
+                                grad_accum=grad_accum,
+                            ))
+                    except Exception as exc:
+                        tel.warn(
+                            "anatomy_failed",
+                            error=f"{type(exc).__name__}: {exc}"[:300],
+                        )
             breakdown = tel is not None and tel.config.breakdown
 
             # live HBM snapshot post-bring-up (params+opt state placed,
@@ -1667,6 +1701,14 @@ def fit(
             mem_every = memory_log_every
             if mem_every is None:
                 mem_every = logger.log_every * 10 if mem_stats else 0
+            # per-interval peak tracking for the cadence rows: the
+            # allocator's peak_bytes_in_use is a LIFETIME high-water mark
+            # — it plateaus after the first big step and hides later
+            # spikes. Watching whether it ADVANCED since the previous
+            # sample recovers the interval's peak (the spike value when
+            # it moved, the current bytes otherwise), appended to the
+            # memory row after the existing fields.
+            mem_peak_seen = (mem_stats or {}).get("peak_bytes_in_use")
 
             global_step = start_step
             logger.start_timer()
@@ -1873,7 +1915,20 @@ def fit(
                             repair_request = repair_ctl.take_trigger()
                             break
                         if mem_every and global_step % mem_every == 0:
-                            logger.log_memory(device_memory_stats())
+                            m = device_memory_stats()
+                            interval_peak = None
+                            if m:
+                                lp = m.get("peak_bytes_in_use")
+                                if lp is not None and (
+                                        mem_peak_seen is None
+                                        or lp > mem_peak_seen):
+                                    interval_peak = lp
+                                    mem_peak_seen = lp
+                                else:
+                                    interval_peak = m.get("bytes_in_use")
+                            logger.log_memory(
+                                m, peak_bytes_in_use=interval_peak
+                            )
                         if ckpt is not None and (
                             (checkpoint_every
                              and global_step % checkpoint_every == 0)
